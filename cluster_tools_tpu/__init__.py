@@ -1,0 +1,22 @@
+"""cluster_tools_tpu — TPU-native distributed bio-image analysis framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+k-dominik/cluster_tools (distributed segmentation workflows for terabyte-scale
+3D EM volumes): blockwise watersheds, region-adjacency graphs and edge
+features, hierarchical (lifted) multicut, mutex watershed, connected
+components + stitching, CNN inference, multiscale export, and evaluation —
+built on sharded arrays over device meshes instead of a file-and-batch-
+scheduler stack.
+"""
+
+__version__ = "0.1.0"
+
+from .core.workflow import Task, DummyTask, build
+from .core.runtime import BlockTask, FailedJobsError
+from .core.blocking import Blocking, blocks_in_volume, block_to_bb
+from .core.storage import file_reader
+
+__all__ = [
+    "Task", "DummyTask", "build", "BlockTask", "FailedJobsError",
+    "Blocking", "blocks_in_volume", "block_to_bb", "file_reader",
+]

@@ -1,0 +1,433 @@
+"""Block-task runtime (L2): executors, job protocol, block-granular retry.
+
+TPU-native re-specification of the reference's cluster runtime
+(cluster_tools/cluster_tasks.py — BaseClusterTask and the five-call job
+protocol at cluster_tasks.py:34-57, backends at :375-620).  Differences by
+design:
+
+* Scheduler backends (sbatch/bsub) are replaced by **executors**:
+  - ``local``   — one subprocess per job (process isolation like the
+                  reference's LocalTask, cluster_tasks.py:493-533);
+  - ``threads`` — in-process thread pool (IO-bound tasks);
+  - ``inline``  — jobs run sequentially in the driver process.  This is the
+                  home of **TPU tasks**: a single process owns the device
+                  mesh, so device work runs inline with blocks batched into
+                  device-wide programs instead of per-block subprocesses.
+* The job protocol is kept: per-job JSON configs embedding the job's block
+  list (round-robin ``block_list[job_id::n_jobs]`` or consecutive), log-line
+  based success detection ("processed block %i" / "processed job %i",
+  reference utils/function_utils.py:11-16), block-granular retry of failed
+  blocks with the ≥50%-failed abort heuristic (cluster_tasks.py:127-142).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from . import config as config_mod
+from .workflow import FileTarget, Task
+
+# ---------------------------------------------------------------------------
+# logging helpers (reference: utils/function_utils.py)
+# ---------------------------------------------------------------------------
+
+_BLOCK_SUCCESS = "processed block"
+_JOB_SUCCESS = "processed job"
+
+
+def log(msg: str, stream=None) -> None:
+    stream = stream or sys.stdout
+    print(f"{datetime.now().isoformat()}: {msg}", file=stream, flush=True)
+
+
+def log_block_success(block_id: int) -> None:
+    log(f"{_BLOCK_SUCCESS} {block_id}")
+
+
+def log_job_success(job_id: int) -> None:
+    log(f"{_JOB_SUCCESS} {job_id}")
+
+
+def parse_job_success(log_path: str, job_id: int) -> bool:
+    """Job succeeded iff its last log line is `processed job <id>`
+    (reference: utils/parse_utils.py:76-93)."""
+    if not os.path.exists(log_path):
+        return False
+    last = ""
+    with open(log_path) as f:
+        for line in f:
+            if line.strip():
+                last = line.strip()
+    return last.endswith(f"{_JOB_SUCCESS} {job_id}")
+
+
+def parse_processed_blocks(log_path: str) -> Set[int]:
+    """Blocks completed by a (possibly failed) job (reference:
+    utils/parse_utils.py:123-154)."""
+    blocks: Set[int] = set()
+    if not os.path.exists(log_path):
+        return blocks
+    with open(log_path) as f:
+        for line in f:
+            line = line.strip()
+            if _BLOCK_SUCCESS in line:
+                try:
+                    blocks.add(int(line.split(_BLOCK_SUCCESS)[1].split()[0]))
+                except (IndexError, ValueError):
+                    pass
+    return blocks
+
+
+def parse_job_runtime(log_path: str) -> Optional[float]:
+    """Seconds between first and last timestamped log line (reference:
+    utils/parse_utils.py:14-63 runtime accounting)."""
+    first = last = None
+    if not os.path.exists(log_path):
+        return None
+    with open(log_path) as f:
+        for line in f:
+            ts = line.split(":", 1)[0]
+            try:
+                t = datetime.fromisoformat(line[: len(ts) + 13].split(": ")[0])
+            except ValueError:
+                continue
+            if first is None:
+                first = t
+            last = t
+    if first is None or last is None:
+        return None
+    return (last - first).total_seconds()
+
+
+class FailedJobsError(RuntimeError):
+    pass
+
+
+#: set in worker subprocesses; guards against fork bombs when a driver script
+#: without an ``if __name__ == "__main__"`` guard is re-executed by the worker
+#: to load its task class
+WORKER_ENV_FLAG = "CLUSTER_TOOLS_TPU_WORKER"
+
+
+def in_worker() -> bool:
+    return os.environ.get(WORKER_ENV_FLAG) == "1"
+
+
+# ---------------------------------------------------------------------------
+# executors
+# ---------------------------------------------------------------------------
+
+class _LocalExecutor:
+    """One subprocess per job, capped at cpu_count concurrent — the analog of
+    the reference's LocalTask ProcessPool (cluster_tasks.py:493-533), but
+    invoking the generic worker entrypoint instead of a copied script."""
+
+    def __init__(self, max_workers: Optional[int] = None):
+        self.max_workers = max_workers or os.cpu_count() or 1
+
+    def run(self, task: "BlockTask", job_ids: Sequence[int]) -> None:
+        def _launch(job_id: int) -> int:
+            log_path = task.log_path(job_id)
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            # workers must see the same packages as the driver, regardless of
+            # the driver's cwd (the package may not be pip-installed)
+            pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            extra_path = [pkg_parent] + [p for p in sys.path if p]
+            prev = env.get("PYTHONPATH")
+            if prev:
+                extra_path.append(prev)
+            env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(extra_path))
+            env[WORKER_ENV_FLAG] = "1"
+            # keep many-process workers from oversubscribing BLAS threads
+            # (reference: utils/numpy_utils.py set_numpy_threads)
+            threads = str(task.task_config.get("threads_per_job", 1))
+            for var in ("OMP_NUM_THREADS", "MKL_NUM_THREADS",
+                        "OPENBLAS_NUM_THREADS", "NUMEXPR_NUM_THREADS"):
+                env[var] = threads
+            with open(log_path, "w") as lf:
+                return subprocess.call(
+                    [sys.executable, "-m", "cluster_tools_tpu.core.worker",
+                     type(task).__module__, type(task).__name__,
+                     task.job_config_path(job_id)],
+                    stdout=lf, stderr=subprocess.STDOUT, env=env,
+                )
+
+        with ThreadPoolExecutor(min(self.max_workers, len(job_ids))) as pool:
+            list(pool.map(_launch, job_ids))
+
+
+class _InlineExecutor:
+    """Run jobs sequentially in the driver process.  TPU tasks use this: the
+    driver owns the device mesh, and per-job work is internally batched into
+    device programs."""
+
+    def run(self, task: "BlockTask", job_ids: Sequence[int]) -> None:
+        for job_id in job_ids:
+            log_path = task.log_path(job_id)
+            with open(log_path, "w") as lf:
+                lock = threading.Lock()
+
+                def _log(msg, _lf=lf, _lock=lock):
+                    with _lock:
+                        print(f"{datetime.now().isoformat()}: {msg}", file=_lf, flush=True)
+
+                try:
+                    _run_job_inline(type(task), task.job_config_path(job_id), _log)
+                except BaseException:  # noqa: BLE001 - failure recorded in log
+                    import traceback
+
+                    _log("job failed with:\n" + traceback.format_exc())
+
+
+class _ThreadExecutor:
+    """In-process thread pool over jobs (IO-bound tasks)."""
+
+    def __init__(self, max_workers: Optional[int] = None):
+        self.max_workers = max_workers or os.cpu_count() or 1
+
+    def run(self, task: "BlockTask", job_ids: Sequence[int]) -> None:
+        def _one(job_id: int) -> None:
+            with open(task.log_path(job_id), "w") as lf:
+                lock = threading.Lock()
+
+                def _log(msg, _lf=lf, _lock=lock):
+                    with _lock:
+                        print(f"{datetime.now().isoformat()}: {msg}", file=_lf, flush=True)
+
+                try:
+                    _run_job_inline(type(task), task.job_config_path(job_id), _log)
+                except BaseException:  # noqa: BLE001
+                    import traceback
+
+                    _log("job failed with:\n" + traceback.format_exc())
+
+        with ThreadPoolExecutor(min(self.max_workers, len(job_ids))) as pool:
+            list(pool.map(_one, job_ids))
+
+
+def _run_job_inline(task_cls, config_path: str, log_fn) -> None:
+    with open(config_path) as f:
+        job_config = json.load(f)
+    job_id = job_config["job_id"]
+    task_cls.process_job(job_id, job_config, log_fn)
+    log_fn(f"{_JOB_SUCCESS} {job_id}")
+
+
+EXECUTORS = {
+    "local": _LocalExecutor,
+    "inline": _InlineExecutor,
+    "tpu": _InlineExecutor,
+    "threads": _ThreadExecutor,
+}
+
+
+# ---------------------------------------------------------------------------
+# BlockTask
+# ---------------------------------------------------------------------------
+
+class BlockTask(Task):
+    """Base for all blockwise tasks (reference: BaseClusterTask,
+    cluster_tasks.py:25-372).
+
+    Universal constructor parameters (reference: WorkflowBase params,
+    cluster_tasks.py:623-654): ``tmp_folder``, ``config_dir``, ``max_jobs``,
+    ``target`` ('local' | 'threads' | 'inline' | 'tpu'), ``dependency``.
+
+    Subclasses implement:
+      * ``run_impl()`` — create outputs, compute the block list, call
+        :meth:`run_jobs`;
+      * classmethod ``process_job(job_id, job_config, log_fn)`` — the worker:
+        loop the job's ``block_list`` calling per-block compute and
+        ``log_fn('processed block %i')`` after each block.
+    """
+
+    task_name: str = ""
+    #: appended to file names so the same task class can run multiple times
+    #: per workflow (e.g. per-scale solves)
+    identifier: str = ""
+    allow_retry: bool = True
+    #: tasks that run as a single global job (reference: cluster_tasks.py:335-341)
+    global_task: bool = False
+
+    def __init__(self, tmp_folder: str, config_dir: str, max_jobs: int = 1,
+                 target: str = "local", dependency: Optional[Task] = None, **kwargs):
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = int(max_jobs)
+        self.target = target
+        self.dependency = dependency
+        super().__init__(**kwargs)
+        self._cfg = config_mod.ConfigDir(config_dir)
+        self.global_config = self._cfg.global_config()
+        self.task_config = self._cfg.task_config(
+            self.task_name, self.default_task_config())
+        os.makedirs(self.tmp_folder, exist_ok=True)
+        os.makedirs(os.path.join(self.tmp_folder, "logs"), exist_ok=True)
+
+    # -- config --------------------------------------------------------
+    @staticmethod
+    def default_task_config() -> Dict[str, Any]:
+        return config_mod.default_task_resources()
+
+    @property
+    def name_with_id(self) -> str:
+        return self.task_name + (f"_{self.identifier}" if self.identifier else "")
+
+    # -- workflow plumbing ---------------------------------------------
+    def requires(self):
+        return self.dependency
+
+    def output(self) -> FileTarget:
+        return FileTarget(os.path.join(self.tmp_folder, f"{self.name_with_id}.status"))
+
+    def run(self) -> None:
+        self._retry_count = 0
+        self.run_impl()
+
+    def run_impl(self) -> None:
+        raise NotImplementedError
+
+    # -- file layout ---------------------------------------------------
+    def job_config_path(self, job_id: int) -> str:
+        return os.path.join(self.tmp_folder,
+                            f"{self.name_with_id}_job_{job_id}.config")
+
+    def log_path(self, job_id: int) -> str:
+        return os.path.join(self.tmp_folder, "logs",
+                            f"{self.name_with_id}_{job_id}.log")
+
+    # -- geometry helpers ----------------------------------------------
+    def global_block_shape(self) -> List[int]:
+        return list(self.global_config["block_shape"])
+
+    def blocks_in_volume(self, shape, block_shape=None) -> List[int]:
+        from .blocking import blocks_in_volume
+
+        gc = self.global_config
+        return blocks_in_volume(
+            shape, block_shape or self.global_block_shape(),
+            roi_begin=gc.get("roi_begin"), roi_end=gc.get("roi_end"),
+            block_list_path=gc.get("block_list_path"),
+        )
+
+    # -- the job protocol ----------------------------------------------
+    def run_jobs(self, block_list: Optional[Sequence[int]],
+                 task_specific_config: Dict[str, Any],
+                 n_jobs: Optional[int] = None,
+                 consecutive_blocks: bool = False) -> None:
+        """Prepare per-job configs, dispatch, check, retry failed blocks.
+
+        ``block_list=None`` runs a single global "reduce-style" job
+        (reference: cluster_tasks.py:335-341).
+        """
+        if in_worker():
+            raise RuntimeError(
+                "run_jobs() called inside a worker process. If your driver "
+                "script defines tasks at module level, guard the driver code "
+                "with `if __name__ == '__main__':` (as with multiprocessing) "
+                "so workers can import the task class without re-running it.")
+        if block_list is None or self.global_task:
+            n_jobs = 1
+            job_blocks: List[Optional[List[int]]] = [
+                None if block_list is None else list(block_list)]
+        else:
+            block_list = list(block_list)
+            n_jobs = min(n_jobs or self.max_jobs, max(len(block_list), 1))
+            if consecutive_blocks:
+                per = (len(block_list) + n_jobs - 1) // n_jobs
+                job_blocks = [block_list[i * per:(i + 1) * per] for i in range(n_jobs)]
+            else:
+                job_blocks = [block_list[j::n_jobs] for j in range(n_jobs)]
+
+        import inspect
+
+        try:
+            src_file = inspect.getfile(type(self))
+        except TypeError:
+            src_file = None
+        for job_id in range(n_jobs):
+            job_config = {
+                "job_id": job_id,
+                "block_list": job_blocks[job_id],
+                "tmp_folder": self.tmp_folder,
+                "config_dir": self.config_dir,
+                "task_name": self.name_with_id,
+                "src_file": src_file,
+                "global_config": self.global_config,
+                "config": {**self.task_config, **task_specific_config},
+            }
+            config_mod.write_config(self.job_config_path(job_id), job_config)
+
+        executor = EXECUTORS[self.target]()
+        t0 = time.time()
+        executor.run(self, list(range(n_jobs)))
+        elapsed = time.time() - t0
+
+        # -- success detection + block-granular retry ------------------
+        failed_jobs = [j for j in range(n_jobs)
+                       if not parse_job_success(self.log_path(j), j)]
+        if not failed_jobs:
+            self._write_status(n_jobs, block_list, elapsed)
+            return
+
+        if (not self.allow_retry
+                or self._retry_count >= int(self.global_config.get("max_num_retries", 0))
+                or block_list is None):
+            self._fail(failed_jobs)
+
+        # majority-of-jobs-failed heuristic: fundamentally broken, don't retry
+        # (reference: cluster_tasks.py:127-134)
+        if len(failed_jobs) > n_jobs / 2:
+            self._fail(failed_jobs)
+
+        processed: Set[int] = set()
+        for j in range(n_jobs):
+            if j in failed_jobs:
+                processed |= parse_processed_blocks(self.log_path(j))
+            else:
+                processed |= set(job_blocks[j] or [])
+        failed_blocks = [b for b in block_list if b not in processed]
+        self._retry_count += 1
+        log(f"{self.name_with_id}: retry {self._retry_count} with "
+            f"{len(failed_blocks)} failed blocks")
+        self.run_jobs(failed_blocks, task_specific_config, n_jobs=n_jobs,
+                      consecutive_blocks=consecutive_blocks)
+
+    def _fail(self, failed_jobs: List[int]) -> None:
+        # rename logs to *_failed.log so the target stays invalid and a driver
+        # rerun redoes this task (reference: cluster_tasks.py:143-151)
+        for j in failed_jobs:
+            lp = self.log_path(j)
+            if os.path.exists(lp):
+                os.replace(lp, lp.replace(".log", "_failed.log"))
+        raise FailedJobsError(
+            f"{self.name_with_id}: jobs {failed_jobs} failed; "
+            f"see {os.path.join(self.tmp_folder, 'logs')}")
+
+    def _write_status(self, n_jobs: int, block_list, elapsed: float) -> None:
+        runtimes = [parse_job_runtime(self.log_path(j)) for j in range(n_jobs)]
+        runtimes = [r for r in runtimes if r is not None]
+        status = {
+            "task": self.name_with_id,
+            "n_jobs": n_jobs,
+            "n_blocks": None if block_list is None else len(block_list),
+            "wall_time": elapsed,
+            "job_runtime_mean": float(sum(runtimes) / len(runtimes)) if runtimes else None,
+            "retries": self._retry_count,
+        }
+        config_mod.write_config(self.output().path, status)
+
+    # -- worker side ----------------------------------------------------
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn) -> None:
+        raise NotImplementedError

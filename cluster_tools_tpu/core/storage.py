@@ -1,0 +1,512 @@
+"""Chunked volume storage (L0): N5 / zarr via tensorstore, HDF5 via h5py.
+
+TPU-native re-specification of the reference's storage layer
+(cluster_tools/utils/volume_utils.py:33-43 `file_reader` dispatching to
+z5py/h5py; datasets are numpy-sliceable, support `require_dataset`, per-chunk
+reads/writes and parallel IO).  Here the chunked-store engine is tensorstore
+(C++ under the hood, async + internally parallel — replacing z5's C++ IO), with
+an `h5py` branch for HDF5 containers.  Irregular ("varlen") per-block results —
+cut-edge lists, sub-solutions — use a dedicated :class:`VarlenDataset` of
+per-chunk flat files instead of z5's varlen chunk encoding.
+
+The store doubles as the inter-task data plane exactly as in the reference
+(SURVEY.md §2.5): chunk-aligned block writes guarantee one writer per chunk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import tensorstore as ts
+except ImportError:  # pragma: no cover - tensorstore is expected in the image
+    ts = None
+
+try:
+    import h5py
+except ImportError:  # pragma: no cover
+    h5py = None
+
+
+# ---------------------------------------------------------------------------
+# dtype mapping
+# ---------------------------------------------------------------------------
+
+_N5_DTYPES = {
+    "uint8": "uint8", "uint16": "uint16", "uint32": "uint32", "uint64": "uint64",
+    "int8": "int8", "int16": "int16", "int32": "int32", "int64": "int64",
+    "float32": "float32", "float64": "float64",
+}
+
+
+def _zarr_dtype(dtype: np.dtype) -> str:
+    return np.dtype(dtype).newbyteorder("<").str
+
+
+# ---------------------------------------------------------------------------
+# attrs
+# ---------------------------------------------------------------------------
+
+class AttrsView:
+    """Dict-like JSON attributes attached to a group/dataset.
+
+    zarr v2 keeps user attributes in ``.zattrs``; N5 merges them into
+    ``attributes.json`` alongside the array metadata (reserved keys are
+    protected).  Mirrors z5py/h5py ``.attrs`` usage in the reference
+    (e.g. ``maxId`` in write/write.py:269-277).
+    """
+
+    _N5_RESERVED = {"dimensions", "blockSize", "dataType", "compression"}
+
+    def __init__(self, path: str, flavor: str):
+        self._flavor = flavor
+        if flavor == "zarr":
+            self._file = os.path.join(path, ".zattrs")
+        else:
+            self._file = os.path.join(path, "attributes.json")
+        self._lock = threading.Lock()
+
+    def _load(self) -> Dict[str, Any]:
+        if not os.path.exists(self._file):
+            return {}
+        with open(self._file) as f:
+            return json.load(f)
+
+    def _store(self, data: Dict[str, Any]) -> None:
+        os.makedirs(os.path.dirname(self._file), exist_ok=True)
+        tmp = self._file + ".tmp%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._file)
+
+    def __getitem__(self, key: str) -> Any:
+        return self._load()[key]
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        if self._flavor == "n5" and key in self._N5_RESERVED:
+            raise KeyError(f"{key} is reserved N5 metadata")
+        with self._lock:
+            data = self._load()
+            data[key] = value
+            self._store(data)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._load()
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._load().get(key, default)
+
+    def update(self, other: Dict[str, Any]) -> None:
+        with self._lock:
+            data = self._load()
+            data.update(other)
+            self._store(data)
+
+    def keys(self):
+        return self._load().keys()
+
+
+# ---------------------------------------------------------------------------
+# tensorstore-backed dataset
+# ---------------------------------------------------------------------------
+
+class Dataset:
+    """A chunked N5/zarr array with numpy-style slicing.
+
+    Reads and writes are synchronous at this interface but parallel inside
+    tensorstore; ``n_threads`` is accepted for reference API compatibility
+    (z5's ds.n_threads, multicut/solve_subproblems.py:241) and ignored.
+    """
+
+    def __init__(self, store: "ts.TensorStore", path: str, flavor: str):
+        self._store = store
+        self.path = path
+        self.flavor = flavor
+        self.attrs = AttrsView(path, flavor)
+        self.n_threads = 1
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._store.shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._store.shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape))
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self._store.dtype.numpy_dtype)
+
+    @property
+    def chunks(self) -> Tuple[int, ...]:
+        return tuple(self._store.chunk_layout.read_chunk.shape)
+
+    def __getitem__(self, bb) -> np.ndarray:
+        return np.asarray(self._store[bb].read().result())
+
+    def __setitem__(self, bb, value) -> None:
+        arr = np.asarray(value)
+        self._store[bb] = arr.astype(self.dtype, copy=False)
+
+    # chunk-wise access (reference: z5 read_chunk/write_chunk,
+    # multicut/solve_subproblems.py:206, multicut/reduce_problem.py:134)
+    def _chunk_bb(self, chunk_id: Sequence[int]):
+        return tuple(
+            slice(c * cs, min((c + 1) * cs, s))
+            for c, cs, s in zip(chunk_id, self.chunks, self.shape)
+        )
+
+    def read_chunk(self, chunk_id: Sequence[int]) -> Optional[np.ndarray]:
+        bb = self._chunk_bb(chunk_id)
+        data = self[bb]
+        if not data.any():
+            return None
+        return data
+
+    def write_chunk(self, chunk_id: Sequence[int], data: np.ndarray) -> None:
+        bb = self._chunk_bb(chunk_id)
+        self[bb] = np.asarray(data).reshape([s.stop - s.start for s in bb])
+
+    def find_max(self) -> float:
+        return float(np.max(self[...]))
+
+
+class _TSContainer:
+    """An N5 or zarr container directory holding groups and datasets."""
+
+    flavor: str = ""
+
+    def __init__(self, path: str, mode: str = "a"):
+        self.path = path
+        self.mode = mode
+        if "r" not in mode or "+" in mode or mode == "a":
+            os.makedirs(path, exist_ok=True)
+            self._init_root()
+        self.attrs = AttrsView(path, self.flavor)
+        self._cache: Dict[Tuple[str, bool], Dataset] = {}
+
+    # -- to be provided by subclasses ----------------------------------
+    def _init_root(self) -> None:
+        raise NotImplementedError
+
+    def _dataset_spec(self, key: str) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _create_spec(
+        self, key: str, shape, chunks, dtype, compression: str
+    ) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _is_dataset(self, key: str) -> bool:
+        raise NotImplementedError
+
+    # -- public container API ------------------------------------------
+    def __contains__(self, key: str) -> bool:
+        return os.path.isdir(os.path.join(self.path, key))
+
+    def __getitem__(self, key: str) -> "Dataset | _TSContainer":
+        if not self._is_dataset(key):
+            if key in self:
+                return self.require_group(key)
+            raise KeyError(key)
+        ck = (key, False)
+        if ck not in self._cache:
+            store = ts.open(self._dataset_spec(key), open=True, read=True,
+                            write=("r" != self.mode)).result()
+            if self.flavor == "n5":
+                # N5 metadata is column-major; transpose to numpy C-order so
+                # shapes/chunks/slicing match the z5py convention.
+                store = store.T
+            self._cache[ck] = Dataset(store, os.path.join(self.path, key), self.flavor)
+        return self._cache[ck]
+
+    def require_group(self, key: str) -> "_TSContainer":
+        sub = type(self)(os.path.join(self.path, key), mode=self.mode)
+        return sub
+
+    def create_group(self, key: str) -> "_TSContainer":
+        return self.require_group(key)
+
+    def require_dataset(
+        self,
+        key: str,
+        shape: Sequence[int],
+        chunks: Sequence[int],
+        dtype,
+        compression: str = "raw",
+        **_ignored: Any,
+    ) -> Dataset:
+        """Create-if-absent (reference: watershed/watershed.py:82-84)."""
+        target = os.path.join(self.path, key)
+        exists = self._is_dataset(key)
+        if not exists:
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            spec = self._create_spec(key, shape, chunks, dtype, compression)
+            ts.open(spec, create=True, open=True).result()
+        ds = self[key]
+        if tuple(ds.shape) != tuple(shape):
+            raise ValueError(
+                f"existing dataset {key} has shape {ds.shape}, requested {tuple(shape)}"
+            )
+        return ds  # type: ignore[return-value]
+
+    create_dataset = require_dataset
+
+    def close(self) -> None:
+        self._cache.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ZarrFile(_TSContainer):
+    flavor = "zarr"
+
+    def _init_root(self) -> None:
+        zgroup = os.path.join(self.path, ".zgroup")
+        if not os.path.exists(zgroup) and not os.path.exists(
+            os.path.join(self.path, ".zarray")
+        ):
+            with open(zgroup, "w") as f:
+                json.dump({"zarr_format": 2}, f)
+
+    def _is_dataset(self, key: str) -> bool:
+        return os.path.exists(os.path.join(self.path, key, ".zarray"))
+
+    def _dataset_spec(self, key: str) -> Dict[str, Any]:
+        return {
+            "driver": "zarr",
+            "kvstore": {"driver": "file", "path": os.path.join(self.path, key)},
+        }
+
+    def _create_spec(self, key, shape, chunks, dtype, compression):
+        compressor = None
+        if compression in ("gzip", "zlib"):
+            compressor = {"id": "zlib", "level": 1}
+        elif compression in ("blosc", "lz4"):
+            compressor = {"id": "blosc", "cname": "lz4", "clevel": 5, "shuffle": 1}
+        return {
+            "driver": "zarr",
+            "kvstore": {"driver": "file", "path": os.path.join(self.path, key)},
+            "metadata": {
+                "shape": list(shape),
+                "chunks": list(chunks),
+                "dtype": _zarr_dtype(dtype),
+                "compressor": compressor,
+                "fill_value": 0,
+            },
+        }
+
+    def require_group(self, key: str) -> "_TSContainer":
+        sub = super().require_group(key)
+        return sub
+
+
+class N5File(_TSContainer):
+    flavor = "n5"
+
+    def _init_root(self) -> None:
+        attrs = os.path.join(self.path, "attributes.json")
+        if not os.path.exists(attrs):
+            with open(attrs, "w") as f:
+                json.dump({"n5": "2.0.0"}, f)
+
+    def _is_dataset(self, key: str) -> bool:
+        meta = os.path.join(self.path, key, "attributes.json")
+        if not os.path.exists(meta):
+            return False
+        with open(meta) as f:
+            return "dimensions" in json.load(f)
+
+    def _dataset_spec(self, key: str) -> Dict[str, Any]:
+        return {
+            "driver": "n5",
+            "kvstore": {"driver": "file", "path": os.path.join(self.path, key)},
+        }
+
+    def _create_spec(self, key, shape, chunks, dtype, compression):
+        np_dtype = np.dtype(dtype).name
+        if np_dtype not in _N5_DTYPES:
+            raise ValueError(f"dtype {np_dtype} not supported by N5")
+        comp = {"type": "raw"}
+        if compression in ("gzip", "zlib"):
+            comp = {"type": "gzip", "level": 1}
+        return {
+            "driver": "n5",
+            "kvstore": {"driver": "file", "path": os.path.join(self.path, key)},
+            "metadata": {
+                # N5 metadata is column-major; tensorstore handles the
+                # transposition so numpy-order shapes are passed reversed.
+                "dimensions": list(shape)[::-1],
+                "blockSize": list(chunks)[::-1],
+                "dataType": _N5_DTYPES[np_dtype],
+                "compression": comp,
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# HDF5 branch
+# ---------------------------------------------------------------------------
+
+class _H5Dataset:
+    """Thin adapter giving h5py datasets the same surface as :class:`Dataset`."""
+
+    def __init__(self, ds):
+        self._ds = ds
+        self.n_threads = 1
+
+    @property
+    def shape(self):
+        return tuple(self._ds.shape)
+
+    @property
+    def ndim(self):
+        return self._ds.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._ds.dtype)
+
+    @property
+    def chunks(self):
+        return tuple(self._ds.chunks) if self._ds.chunks else tuple(self._ds.shape)
+
+    @property
+    def attrs(self):
+        return self._ds.attrs
+
+    def __getitem__(self, bb):
+        return self._ds[bb]
+
+    def __setitem__(self, bb, value):
+        self._ds[bb] = value
+
+    def find_max(self) -> float:
+        return float(np.max(self._ds[...]))
+
+
+class H5File:
+    flavor = "h5"
+
+    def __init__(self, path: str, mode: str = "a"):
+        self.path = path
+        self._f = h5py.File(path, mode)
+        self.attrs = self._f.attrs
+
+    def __contains__(self, key):
+        return key in self._f
+
+    def __getitem__(self, key):
+        obj = self._f[key]
+        if isinstance(obj, h5py.Dataset):
+            return _H5Dataset(obj)
+        return obj
+
+    def require_group(self, key):
+        return self._f.require_group(key)
+
+    create_group = require_group
+
+    def require_dataset(self, key, shape, chunks, dtype, compression=None, **kw):
+        if compression == "raw":
+            compression = None
+        ds = self._f.require_dataset(
+            key, shape=tuple(shape), chunks=tuple(chunks), dtype=dtype,
+            compression=compression,
+        )
+        return _H5Dataset(ds)
+
+    create_dataset = require_dataset
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# varlen per-chunk results
+# ---------------------------------------------------------------------------
+
+class VarlenDataset:
+    """Variable-length per-chunk flat arrays (replaces z5 varlen chunks used for
+    cut-edge ids / per-block node results, multicut/solve_subproblems.py:204-211).
+
+    Layout: one ``.npy`` file per chunk id under a directory, plus JSON attrs.
+    Chunk writes are single-writer by construction (one block -> one chunk),
+    matching the reference's race-freedom-by-layout design (SURVEY.md §5.2).
+    """
+
+    def __init__(self, path: str, dtype="uint64"):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.dtype = np.dtype(dtype)
+        self.attrs = AttrsView(path, "n5")
+
+    def _chunk_file(self, chunk_id: Sequence[int]) -> str:
+        return os.path.join(self.path, "chunk_" + "_".join(map(str, chunk_id)) + ".npy")
+
+    def write_chunk(self, chunk_id: Sequence[int], data: np.ndarray) -> None:
+        arr = np.ascontiguousarray(data, dtype=self.dtype)
+        tmp = self._chunk_file(chunk_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            np.save(f, arr)
+        os.replace(tmp, self._chunk_file(chunk_id))
+
+    def read_chunk(self, chunk_id: Sequence[int]) -> Optional[np.ndarray]:
+        f = self._chunk_file(chunk_id)
+        if not os.path.exists(f):
+            return None
+        return np.load(f)
+
+    def chunk_ids(self):
+        out = []
+        for name in sorted(os.listdir(self.path)):
+            if name.startswith("chunk_") and name.endswith(".npy"):
+                out.append(tuple(int(p) for p in name[6:-4].split("_")))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+HDF5_EXTS = {".h5", ".hdf", ".hdf5"}
+ZARR_EXTS = {".zarr", ".zr"}
+N5_EXTS = {".n5"}
+
+
+def file_reader(path: str, mode: str = "a"):
+    """Open a container by extension (reference: utils/volume_utils.py:33-43)."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext in N5_EXTS:
+        return N5File(path, mode)
+    if ext in ZARR_EXTS:
+        return ZarrFile(path, mode)
+    if ext in HDF5_EXTS:
+        return H5File(path, mode)
+    raise ValueError(f"unsupported container extension: {path}")
+
+
+def get_shape(path: str, key: str) -> Tuple[int, ...]:
+    with file_reader(path, "r") as f:
+        return tuple(f[key].shape)

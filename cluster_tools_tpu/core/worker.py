@@ -1,0 +1,58 @@
+"""Generic worker entrypoint for subprocess jobs.
+
+Replaces the reference's script-copy + shebang-rewrite job materialization
+(cluster_tasks.py:352-372): instead of copying each task's source file into
+tmp and executing it, workers re-import the task class from the installed
+package and run its ``process_job``.  Invoked as::
+
+    python -m cluster_tools_tpu.core.worker <module> <class> <job_config.json>
+
+stdout is the job log; success is signalled by the final "processed job %i"
+line (reference protocol, utils/function_utils.py:11-16).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import json
+import sys
+
+
+def main(argv) -> int:
+    module_name, class_name, config_path = argv[:3]
+    from .runtime import log, log_job_success
+
+    with open(config_path) as f:
+        job_config = json.load(f)
+    job_id = job_config["job_id"]
+
+    try:
+        if module_name == "__main__":
+            # the driver defined the task in its entry script; "__main__" here
+            # is the worker itself, so force the source-file load below
+            raise ModuleNotFoundError("__main__")
+        module = importlib.import_module(module_name)
+    except ModuleNotFoundError:
+        # task class defined outside an importable package (e.g. a test file):
+        # load it from its source file, the moral equivalent of the
+        # reference's copy-script-into-tmp job materialization.
+        src_file = job_config.get("src_file")
+        if not src_file:
+            raise
+        spec = importlib.util.spec_from_file_location(module_name, src_file)
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[module_name] = module
+        spec.loader.exec_module(module)
+    task_cls = getattr(module, class_name)
+
+    def log_fn(msg: str) -> None:
+        log(msg)
+
+    task_cls.process_job(job_id, job_config, log_fn)
+    log_job_success(job_id)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

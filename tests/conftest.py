@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding/collective paths are
+validated on a virtual CPU mesh (xla_force_host_platform_device_count), the
+standard JAX technique for testing pjit/shard_map layouts without TPUs.
+Must run before the first jax import anywhere in the test session.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_workdir(tmp_path):
+    """tmp_folder + config_dir pair with a small-block global config."""
+    from cluster_tools_tpu.core.config import ConfigDir
+
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "configs")
+    cfg = ConfigDir(config_dir)
+    cfg.write_global_config({"block_shape": [10, 10, 10], "max_num_retries": 0})
+    return tmp_folder, config_dir

@@ -1,0 +1,107 @@
+"""Blocking geometry tests (reference test strategy: recompute-in-numpy
+oracles, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.core.blocking import (
+    Blocking, blocks_in_volume, iterate_faces,
+)
+
+
+def test_grid_shape_and_clipping():
+    b = Blocking([100, 95, 10], [32, 32, 10])
+    assert b.grid_shape == (4, 3, 1)
+    assert b.n_blocks == 12
+    last = b.get_block(b.n_blocks - 1)
+    assert last.begin == (96, 64, 0)
+    assert last.end == (100, 95, 10)
+    assert last.shape == (4, 31, 10)
+
+
+def test_block_ids_roundtrip_and_cover():
+    shape, bs = [37, 23, 11], [10, 7, 4]
+    b = Blocking(shape, bs)
+    cover = np.zeros(shape, dtype=int)
+    for bid in range(b.n_blocks):
+        assert b.grid_position_to_id(b.block_grid_position(bid)) == bid
+        cover[b.get_block(bid).bb] += 1
+    # exact partition: every voxel covered exactly once
+    assert (cover == 1).all()
+
+
+def test_halo_clipping_and_local():
+    b = Blocking([100, 100], [25, 25])
+    bh = b.get_block_with_halo(0, [5, 5])
+    assert bh.outer.begin == (0, 0)
+    assert bh.outer.end == (30, 30)
+    assert bh.inner_local.begin == (0, 0)
+    bh = b.get_block_with_halo(5, [5, 5])  # grid pos (1, 1)
+    assert bh.outer.begin == (20, 20)
+    assert bh.outer.end == (55, 55)
+    assert bh.inner_local.begin == (5, 5)
+    assert bh.inner_local.end == (30, 30)
+
+
+def test_blocks_in_roi():
+    ids = blocks_in_volume([100, 100], [25, 25], roi_begin=[30, 0], roi_end=[60, 100])
+    b = Blocking([100, 100], [25, 25])
+    expected = [
+        bid for bid in range(b.n_blocks)
+        if b.get_block(bid).begin[0] < 60 and b.get_block(bid).end[0] > 30
+    ]
+    assert sorted(ids) == sorted(expected)
+
+
+def test_block_list_path(tmp_path):
+    import json
+
+    p = tmp_path / "blocks.json"
+    p.write_text(json.dumps([0, 3, 5]))
+    ids = blocks_in_volume([100, 100], [25, 25], block_list_path=str(p))
+    assert ids == [0, 3, 5]
+
+
+def test_checkerboard_no_adjacent_same_color():
+    b = Blocking([40, 40, 40], [10, 10, 10])
+    colors = b.checkerboard()
+    assert sorted(colors[0] + colors[1]) == list(range(b.n_blocks))
+    color_of = {bid: c for c, ids in enumerate(colors) for bid in ids}
+    for bid in range(b.n_blocks):
+        for axis in range(3):
+            for d in (-1, 1):
+                nid = b.neighbor_id(bid, axis, d)
+                if nid is not None:
+                    assert color_of[nid] != color_of[bid]
+
+
+def test_faces_pair_each_boundary_once():
+    b = Blocking([20, 20], [10, 10])
+    seen = set()
+    for bid in range(b.n_blocks):
+        for face in iterate_faces(b, bid, halo=[1, 1]):
+            key = (face.block_a, face.block_b, face.axis)
+            assert key not in seen
+            seen.add(key)
+            assert face.block_a < face.block_b
+    # 2x2 grid: 2 vertical + 2 horizontal faces
+    assert len(seen) == 4
+
+
+def test_face_geometry_selects_touching_strips():
+    b = Blocking([20, 10], [10, 10])
+    faces = list(iterate_faces(b, 1, halo=[2, 2]))
+    assert len(faces) == 1
+    f = faces[0]
+    vol = np.arange(200).reshape(20, 10)
+    region = vol[f.outer_bb]
+    assert region.shape == (4, 10)
+    np.testing.assert_array_equal(region[f.face_a], vol[8:10, :])
+    np.testing.assert_array_equal(region[f.face_b], vol[10:12, :])
+
+
+def test_invalid_args():
+    with pytest.raises(ValueError):
+        Blocking([10], [5, 5])
+    with pytest.raises(ValueError):
+        blocks_in_volume([10, 10], [5, 5], roi_begin=[0, 0])

@@ -1,0 +1,96 @@
+"""Storage layer tests: zarr / n5 / hdf5 round-trips, attrs, chunk IO."""
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.core.storage import (
+    VarlenDataset, file_reader, get_shape,
+)
+
+
+@pytest.mark.parametrize("ext", [".zarr", ".n5", ".h5"])
+def test_roundtrip(tmp_path, ext):
+    path = str(tmp_path / ("vol" + ext))
+    data = np.random.rand(32, 48).astype("float32")
+    with file_reader(path) as f:
+        ds = f.require_dataset("data", shape=data.shape, chunks=(16, 16),
+                               dtype="float32")
+        ds[:, :] = data
+    with file_reader(path, "r") as f:
+        out = f["data"][:, :]
+    np.testing.assert_allclose(out, data)
+    assert get_shape(path, "data") == (32, 48)
+
+
+@pytest.mark.parametrize("ext", [".zarr", ".n5"])
+def test_partial_write_and_chunks(tmp_path, ext):
+    path = str(tmp_path / ("vol" + ext))
+    with file_reader(path) as f:
+        ds = f.require_dataset("seg", shape=(40, 40), chunks=(10, 10),
+                               dtype="uint64")
+        ds[10:20, 10:20] = np.full((10, 10), 7, dtype="uint64")
+        assert ds.chunks == (10, 10)
+        chunk = ds.read_chunk((1, 1))
+        assert chunk is not None and (chunk == 7).all()
+        assert ds.read_chunk((0, 0)) is None  # all-zero chunk
+        ds.write_chunk((2, 2), np.full((10, 10), 3, dtype="uint64"))
+    with file_reader(path, "r") as f:
+        assert (f["seg"][20:30, 20:30] == 3).all()
+        assert f["seg"][0, 0] == 0
+
+
+@pytest.mark.parametrize("ext", [".zarr", ".n5"])
+def test_attrs(tmp_path, ext):
+    path = str(tmp_path / ("vol" + ext))
+    with file_reader(path) as f:
+        ds = f.require_dataset("seg", shape=(8, 8), chunks=(8, 8), dtype="uint32")
+        ds.attrs["maxId"] = 41
+        f.attrs["global"] = {"a": 1}
+    with file_reader(path, "r") as f:
+        assert f["seg"].attrs["maxId"] == 41
+        assert f.attrs["global"] == {"a": 1}
+        assert f["seg"].attrs.get("missing", "dflt") == "dflt"
+
+
+def test_groups_nested(tmp_path):
+    path = str(tmp_path / "vol.n5")
+    with file_reader(path) as f:
+        g = f.require_group("s0")
+        ds = g.require_dataset("graph", shape=(4,), chunks=(4,), dtype="int64")
+        ds[:] = np.arange(4)
+    with file_reader(path, "r") as f:
+        np.testing.assert_array_equal(f["s0"]["graph"][:], np.arange(4))
+        np.testing.assert_array_equal(f["s0/graph"][:], np.arange(4))
+
+
+def test_require_dataset_idempotent_and_shape_check(tmp_path):
+    path = str(tmp_path / "vol.zarr")
+    with file_reader(path) as f:
+        f.require_dataset("d", shape=(8, 8), chunks=(4, 4), dtype="float32")
+        f.require_dataset("d", shape=(8, 8), chunks=(4, 4), dtype="float32")
+        with pytest.raises(ValueError):
+            f.require_dataset("d", shape=(9, 9), chunks=(4, 4), dtype="float32")
+
+
+def test_varlen_dataset(tmp_path):
+    vd = VarlenDataset(str(tmp_path / "cut_edges"), dtype="uint64")
+    vd.write_chunk((0, 1, 2), np.array([5, 9, 11], dtype="uint64"))
+    vd.write_chunk((1, 0, 0), np.arange(100, dtype="uint64"))
+    assert vd.read_chunk((9, 9, 9)) is None
+    np.testing.assert_array_equal(vd.read_chunk((0, 1, 2)), [5, 9, 11])
+    assert vd.chunk_ids() == [(0, 1, 2), (1, 0, 0)]
+    vd.attrs["n_blocks"] = 2
+    assert vd.attrs["n_blocks"] == 2
+
+
+def test_n5_readable_by_raw_metadata(tmp_path):
+    """N5 on disk must be real N5: column-major dims in attributes.json."""
+    import json, os
+
+    path = str(tmp_path / "vol.n5")
+    with file_reader(path) as f:
+        f.require_dataset("d", shape=(16, 8), chunks=(8, 4), dtype="uint8")
+    with open(os.path.join(path, "d", "attributes.json")) as fh:
+        meta = json.load(fh)
+    assert meta["dimensions"] == [8, 16]
+    assert meta["blockSize"] == [4, 8]
