@@ -172,7 +172,11 @@ def blocks_in_volume(
     else:
         block_ids = list(range(blocking.n_blocks))
 
-    if block_list_path is not None and os.path.exists(block_list_path):
+    if block_list_path is not None:
+        if not os.path.exists(block_list_path):
+            raise FileNotFoundError(
+                f"block_list_path {block_list_path} is configured but does "
+                "not exist — refusing to silently process all blocks")
         with open(block_list_path) as f:
             allowed = set(json.load(f))
         block_ids = [bid for bid in block_ids if bid in allowed]
@@ -223,17 +227,23 @@ def iterate_faces(
                 continue
             h = int(halo[axis])
             boundary = block.begin[axis] if direction == -1 else block.end[axis]
+            # clip to the volume so thin border blocks don't overflow
+            lo_edge = max(boundary - h, 0)
+            hi_edge = min(boundary + h, blocking.shape[axis])
+            lo_extent = boundary - lo_edge
             outer_bb = []
             for d in range(ndim):
                 if d == axis:
-                    outer_bb.append(slice(boundary - h, boundary + h))
+                    outer_bb.append(slice(lo_edge, hi_edge))
                 else:
                     outer_bb.append(slice(block.begin[d], block.end[d]))
             face_lo = tuple(
-                slice(0, h) if d == axis else slice(None) for d in range(ndim)
+                slice(0, lo_extent) if d == axis else slice(None)
+                for d in range(ndim)
             )
             face_hi = tuple(
-                slice(h, 2 * h) if d == axis else slice(None) for d in range(ndim)
+                slice(lo_extent, hi_edge - lo_edge) if d == axis else slice(None)
+                for d in range(ndim)
             )
             if direction == -1:
                 yield Face(

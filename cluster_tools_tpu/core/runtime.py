@@ -183,7 +183,7 @@ class _InlineExecutor:
 
                 try:
                     _run_job_inline(type(task), task.job_config_path(job_id), _log)
-                except BaseException:  # noqa: BLE001 - failure recorded in log
+                except Exception:
                     import traceback
 
                     _log("job failed with:\n" + traceback.format_exc())
@@ -206,7 +206,7 @@ class _ThreadExecutor:
 
                 try:
                     _run_job_inline(type(task), task.job_config_path(job_id), _log)
-                except BaseException:  # noqa: BLE001
+                except Exception:
                     import traceback
 
                     _log("job failed with:\n" + traceback.format_exc())
@@ -258,6 +258,9 @@ class BlockTask(Task):
     allow_retry: bool = True
     #: tasks that run as a single global job (reference: cluster_tasks.py:335-341)
     global_task: bool = False
+    #: retry attempt counter (class default so run_jobs() works when called
+    #: directly, without going through run())
+    _retry_count: int = 0
 
     def __init__(self, tmp_folder: str, config_dir: str, max_jobs: int = 1,
                  target: str = "local", dependency: Optional[Task] = None, **kwargs):

@@ -310,10 +310,6 @@ class ZarrFile(_TSContainer):
             },
         }
 
-    def require_group(self, key: str) -> "_TSContainer":
-        sub = super().require_group(key)
-        return sub
-
 
 class N5File(_TSContainer):
     flavor = "n5"

@@ -25,7 +25,10 @@ def normalize_index(index, shape) -> Tuple[Tuple[slice, ...], Tuple[int, ...]]:
     slices = []
     for d, ind in enumerate(index):
         if isinstance(ind, (int, np.integer)):
-            slices.append(slice(int(ind), int(ind) + 1))
+            i = int(ind)
+            if i < 0:
+                i += shape[d]
+            slices.append(slice(i, i + 1))
             squeeze_axes.append(d)
         elif isinstance(ind, slice):
             start = 0 if ind.start is None else int(ind.start)
@@ -134,7 +137,7 @@ class TransformedVolume:
         else:
             sub = np.asarray(self.volume[tuple(slice(l, h) for l, h in zip(lo, hi))])
             out = affine_transform(
-                sub, lin, offset=trans - lin @ np.zeros(self.ndim) - lo,
+                sub, lin, offset=trans - lo,
                 output_shape=out_shape, order=self.order,
                 mode="constant", cval=self.fill_value,
             ).astype(self.dtype, copy=False)

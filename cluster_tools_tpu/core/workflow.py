@@ -78,7 +78,9 @@ class Task:
     @property
     def task_id(self) -> str:
         out = self.output()
-        suffix = out.path if isinstance(out, FileTarget) else ""
+        # non-FileTarget outputs get identity-based ids so two distinct task
+        # instances are never silently deduplicated
+        suffix = out.path if isinstance(out, FileTarget) else hex(id(self))
         return f"{type(self).__name__}:{suffix}"
 
     def _deps(self) -> List["Task"]:
@@ -92,6 +94,8 @@ class Task:
 
 class DummyTask(Task):
     """Always-complete dependency root (reference: utils/task_utils.py:11-15)."""
+
+    task_id = "DummyTask"  # all instances interchangeable
 
     def output(self) -> Target:
         return DummyTarget()
@@ -137,7 +141,7 @@ def build(tasks: Iterable[Task], raise_on_failure: bool = False) -> bool:
         logger.info("running task %s", task.task_id)
         try:
             task.run()
-        except BaseException as e:  # noqa: BLE001 - report any task failure
+        except Exception as e:
             logger.error("task %s failed:\n%s", task.task_id, traceback.format_exc())
             if raise_on_failure:
                 raise BuildError(task, e) from e

@@ -1,0 +1,154 @@
+"""On-device connected components via union-find label propagation.
+
+TPU-native replacement for the reference's per-block ``skimage.label``
+(thresholded_components/block_components.py:143-180) and vigra
+``labelVolumeWithBackground``.  The algorithm is Shiloach–Vishkin-style
+hooking + pointer jumping expressed in pure JAX: every voxel starts as its own
+parent; each iteration (a) takes the min parent over face/corner neighbors,
+(b) scatter-min "hooks" that value onto the current root, (c) compresses paths
+by pointer jumping.  Convergence is O(log d) iterations for component diameter
+d — data-independent control flow per iteration, static shapes, fully
+jit/vmap-compatible (SPMD over blocks via vmap; over shards via shard_map).
+
+Labels are returned as root-voxel linear indices + 1 (0 = background) —
+globally meaningful within the block, made consecutive by the caller when
+needed (host-side np.unique, reference semantics of relabelConsecutive).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from itertools import product
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _neighbor_offsets(ndim: int, connectivity: int) -> Tuple[Tuple[int, ...], ...]:
+    """Neighbor offsets with L1 norm <= connectivity (scipy/skimage convention:
+    connectivity=1 -> faces, ndim -> full including corners)."""
+    offs = []
+    for off in product((-1, 0, 1), repeat=ndim):
+        d = sum(abs(o) for o in off)
+        if 0 < d <= connectivity:
+            offs.append(off)
+    return tuple(offs)
+
+
+def _shifted(arr: jnp.ndarray, offset: Sequence[int], fill) -> jnp.ndarray:
+    """Value of the neighbor at position ``i + offset`` for every voxel i,
+    with out-of-volume neighbors reading ``fill``.  Static pad+slice (no roll
+    wraparound), fuses into one XLA op chain."""
+    pads = []
+    slices = []
+    for o, s in zip(offset, arr.shape):
+        if o > 0:
+            pads.append((0, o))
+            slices.append(slice(o, o + s))
+        elif o < 0:
+            pads.append((-o, 0))
+            slices.append(slice(0, s))
+        else:
+            pads.append((0, 0))
+            slices.append(slice(0, s))
+    padded = jnp.pad(arr, pads, constant_values=fill)
+    return padded[tuple(slices)]
+
+
+@partial(jax.jit, static_argnames=("connectivity", "max_iter"))
+def connected_components(
+    mask: jnp.ndarray, connectivity: int = 1, max_iter: int = 0
+) -> jnp.ndarray:
+    """Label connected components of a boolean mask.
+
+    Returns an int32 array: 0 for background, ``root_linear_index + 1`` for
+    foreground.  ``connectivity`` follows the scipy/skimage convention
+    (1 = faces, ndim = full).  ``max_iter=0`` derives a safe bound from the
+    volume size (2 * sum(shape) covers the worst-case path with pointer
+    jumping's logarithmic compression well before the bound is hit; the loop
+    exits early on convergence).
+    """
+    shape = mask.shape
+    n = int(np.prod(shape))
+    sentinel = jnp.int32(n)
+    mask = mask.astype(bool)
+    offsets = _neighbor_offsets(len(shape), connectivity)
+    if max_iter == 0:
+        max_iter = max(2 * int(np.sum(shape)), 16)
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    fg = mask.reshape(-1)
+    p0 = idx  # every voxel its own parent (background voxels stay fixed points)
+
+    def neighbor_min(p: jnp.ndarray) -> jnp.ndarray:
+        grid = jnp.where(mask, p.reshape(shape), sentinel)
+        m = grid
+        for off in offsets:
+            m = jnp.minimum(m, _shifted(grid, off, sentinel))
+        return jnp.where(fg, m.reshape(-1), p)
+
+    def body(state):
+        p, _ = state
+        m = neighbor_min(p)
+        # hook the improved root onto the current root, then compress
+        p2 = p.at[p].min(m)
+        p2 = p2[p2]
+        p2 = p2[p2]
+        changed = jnp.any(p2 != p)
+        return p2, changed
+
+    def cond(state):
+        return state[1]
+
+    p, _ = jax.lax.while_loop(cond, body, (p0, jnp.bool_(True)))
+    return jnp.where(fg, p + 1, 0).reshape(shape).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("connectivity",))
+def connected_components_batched(
+    masks: jnp.ndarray, connectivity: int = 1
+) -> jnp.ndarray:
+    """CC over a batch of equally-shaped blocks (leading batch axis).
+
+    The batch shares one jitted program — blocks are processed SPMD via vmap,
+    the TPU-native replacement for the reference's one-subprocess-per-block
+    fan-out.
+    """
+    return jax.vmap(lambda m: connected_components(m, connectivity=connectivity))(masks)
+
+
+def relabel_consecutive(
+    labels: np.ndarray, start_label: int = 1, keep_zeros: bool = True
+) -> Tuple[np.ndarray, int]:
+    """Host-side consecutive relabeling (reference: vigra relabelConsecutive,
+    used ubiquitously).  Returns (relabeled, max_id)."""
+    labels = np.asarray(labels)
+    uniques = np.unique(labels)
+    if keep_zeros and uniques.size and uniques[0] == 0:
+        nonzero = uniques[1:]
+        mapping_vals = np.arange(start_label, start_label + nonzero.size,
+                                 dtype=labels.dtype)
+        lookup = {0: 0}
+        new = np.searchsorted(nonzero, labels)
+        out = np.where(labels == 0, 0, new + start_label).astype(np.uint64)
+        max_id = start_label + nonzero.size - 1 if nonzero.size else 0
+        del mapping_vals, lookup
+        return out, int(max_id)
+    new = np.searchsorted(uniques, labels)
+    out = (new + start_label).astype(np.uint64)
+    return out, int(start_label + uniques.size - 1)
+
+
+def threshold_volume(
+    x: jnp.ndarray, threshold: float, mode: str = "greater"
+) -> jnp.ndarray:
+    """Thresholding modes of the reference (block_components.py)."""
+    if mode == "greater":
+        return x > threshold
+    if mode == "less":
+        return x < threshold
+    if mode == "equal":
+        return x == threshold
+    raise ValueError(f"unknown threshold mode {mode}")

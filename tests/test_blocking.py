@@ -105,3 +105,16 @@ def test_invalid_args():
         Blocking([10], [5, 5])
     with pytest.raises(ValueError):
         blocks_in_volume([10, 10], [5, 5], roi_begin=[0, 0])
+
+
+def test_face_clipped_at_thin_border_block():
+    # last block along axis 0 is 1 thick (21 = 2*10 + 1); halo 2 must clip
+    b = Blocking([21, 10], [10, 10])
+    faces = [f for f in iterate_faces(b, 2, halo=[2, 2])]
+    assert len(faces) == 1
+    f = faces[0]
+    vol = np.arange(210).reshape(21, 10)
+    region = vol[f.outer_bb]
+    assert region.shape == (3, 10)  # 2 below boundary, 1 above (clipped)
+    np.testing.assert_array_equal(region[f.face_a], vol[18:20, :])
+    np.testing.assert_array_equal(region[f.face_b], vol[20:21, :])

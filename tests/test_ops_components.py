@@ -1,0 +1,77 @@
+"""Device connected-components kernel vs scipy oracle."""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+import jax.numpy as jnp
+
+from cluster_tools_tpu.ops.components import (
+    connected_components, connected_components_batched, relabel_consecutive,
+    threshold_volume,
+)
+
+
+def _same_partition(ours, ref):
+    assert ((ours == 0) == (ref == 0)).all()
+    fg = ref != 0
+    pairs = np.unique(np.stack([ours[fg], ref[fg]]), axis=1)
+    assert len(np.unique(pairs[0])) == pairs.shape[1]
+    assert len(np.unique(pairs[1])) == pairs.shape[1]
+
+
+@pytest.mark.parametrize("shape,connectivity", [
+    ((32, 32), 1), ((32, 32), 2),
+    ((16, 16, 16), 1), ((16, 16, 16), 3),
+])
+def test_cc_matches_scipy(shape, connectivity):
+    rng = np.random.RandomState(42)
+    mask = rng.rand(*shape) > 0.6
+    ours = np.asarray(connected_components(jnp.asarray(mask),
+                                           connectivity=connectivity))
+    struct = ndimage.generate_binary_structure(len(shape), connectivity)
+    ref, _ = ndimage.label(mask, structure=struct)
+    _same_partition(ours, ref)
+
+
+def test_cc_worst_case_snake():
+    # serpentine path: single component with very long graph diameter,
+    # stresses the pointer-jumping convergence bound
+    mask = np.zeros((16, 16), dtype=bool)
+    for i in range(16):
+        mask[i, :] = True
+        if i + 1 < 16:
+            mask[i, -1 if i % 2 == 0 else 0] = True
+    mask[1::2, 0] = False
+    mask[0::2, 15] = True
+    for i in range(0, 15):
+        mask[i, 15 if i % 2 == 0 else 0] = True
+    ours = np.asarray(connected_components(jnp.asarray(mask)))
+    ref, _ = ndimage.label(mask)
+    _same_partition(ours, ref)
+
+
+def test_cc_batched_equals_single():
+    rng = np.random.RandomState(0)
+    masks = rng.rand(4, 12, 12, 12) > 0.5
+    batched = np.asarray(connected_components_batched(jnp.asarray(masks)))
+    for i in range(4):
+        single = np.asarray(connected_components(jnp.asarray(masks[i])))
+        np.testing.assert_array_equal(batched[i], single)
+
+
+def test_relabel_consecutive():
+    labels = np.array([[0, 5, 5], [9, 0, 2]], dtype="uint64")
+    out, max_id = relabel_consecutive(labels)
+    assert max_id == 3
+    assert set(np.unique(out)) == {0, 1, 2, 3}
+    assert ((labels == 0) == (out == 0)).all()
+
+
+def test_threshold_modes():
+    x = jnp.asarray(np.array([0.1, 0.5, 0.9]))
+    assert np.asarray(threshold_volume(x, 0.5, "greater")).tolist() == [False, False, True]
+    assert np.asarray(threshold_volume(x, 0.5, "less")).tolist() == [True, False, False]
+    assert np.asarray(threshold_volume(x, 0.5, "equal")).tolist() == [False, True, False]
+    with pytest.raises(ValueError):
+        threshold_volume(x, 0.5, "bogus")

@@ -136,7 +136,6 @@ def test_no_retry_raises(tmp_workdir, tmp_path):
     task.run_jobs = run_jobs
     assert not build([task])
     with pytest.raises(FailedJobsError):
-        task._retry_count = 0
         task.run_impl()
     # failed logs renamed -> target invalid -> task not complete
     assert not task.complete()

@@ -94,3 +94,13 @@ def test_n5_readable_by_raw_metadata(tmp_path):
         meta = json.load(fh)
     assert meta["dimensions"] == [8, 16]
     assert meta["blockSize"] == [4, 8]
+
+
+def test_interpolated_volume_negative_index():
+    from cluster_tools_tpu.core.volume_views import InterpolatedVolume
+
+    low = np.arange(8, dtype="float32").reshape(2, 2, 2)
+    view = InterpolatedVolume(low, (4, 4, 4), spline_order=0)
+    np.testing.assert_array_equal(view[-1], view[3])
+    np.testing.assert_array_equal(view[-1], np.repeat(
+        np.repeat(low[1], 2, axis=0), 2, axis=1))

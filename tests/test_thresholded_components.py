@@ -1,0 +1,102 @@
+"""End-to-end ThresholdedComponentsWorkflow test vs full-volume scipy oracle
+(reference test style: recompute-in-numpy, test/thresholded_components/)."""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from cluster_tools_tpu.core.storage import file_reader
+from cluster_tools_tpu.core.workflow import build
+from cluster_tools_tpu.workflows.thresholded_components import (
+    ThresholdedComponentsWorkflow,
+)
+
+
+def _partitions_equal(a: np.ndarray, b: np.ndarray) -> bool:
+    """True iff the two label images induce the same partition (bijective
+    label correspondence), background fixed at 0."""
+    if not ((a == 0) == (b == 0)).all():
+        return False
+    fg = a != 0
+    pairs = np.unique(np.stack([a[fg], b[fg]]), axis=1)
+    return (len(np.unique(pairs[0])) == pairs.shape[1]
+            and len(np.unique(pairs[1])) == pairs.shape[1])
+
+
+def _make_volume(shape, seed=0):
+    rng = np.random.RandomState(seed)
+    # smooth-ish random field so components span many blocks
+    vol = rng.rand(*shape).astype("float32")
+    vol = ndimage.uniform_filter(vol, size=3)
+    return vol
+
+
+@pytest.mark.parametrize("target", ["inline", "local"])
+def test_thresholded_components_vs_scipy(tmp_workdir, tmp_path, target):
+    tmp_folder, config_dir = tmp_workdir
+    shape = (30, 30, 30)
+    vol = _make_volume(shape)
+    threshold = 0.5
+
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        ds = f.require_dataset("raw", shape=shape, chunks=(10, 10, 10),
+                               dtype="float32")
+        ds[...] = vol
+
+    wf = ThresholdedComponentsWorkflow(
+        input_path=path, input_key="raw", output_path=path, output_key="cc",
+        threshold=threshold, tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=4, target=target)
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        result = f["cc"][...]
+        max_id = f["cc"].attrs["maxId"]
+
+    expected, n_exp = ndimage.label(vol > threshold)
+    assert _partitions_equal(result, expected.astype("uint64"))
+    assert len(np.unique(result[result != 0])) == n_exp
+    assert max_id == n_exp
+    # consecutive labels 1..n
+    assert result.max() == n_exp
+
+
+def test_single_component_spanning_all_blocks(tmp_workdir, tmp_path):
+    tmp_folder, config_dir = tmp_workdir
+    shape = (20, 20, 20)
+    vol = np.zeros(shape, dtype="float32")
+    # a 3D cross through the whole volume: one component crossing all axes
+    vol[10, :, :] = 1.0
+    vol[:, 10, :] = 1.0
+    vol[:, :, 10] = 1.0
+
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.require_dataset("raw", shape=shape, chunks=(10, 10, 10),
+                          dtype="float32")[...] = vol
+    wf = ThresholdedComponentsWorkflow(
+        input_path=path, input_key="raw", output_path=path, output_key="cc",
+        threshold=0.5, tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="inline")
+    assert build([wf], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        result = f["cc"][...]
+    assert (result[vol > 0.5] == 1).all()
+    assert (result[vol <= 0.5] == 0).all()
+
+
+def test_empty_volume(tmp_workdir, tmp_path):
+    tmp_folder, config_dir = tmp_workdir
+    shape = (20, 20, 20)
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.require_dataset("raw", shape=shape, chunks=(10, 10, 10),
+                          dtype="float32")[...] = np.zeros(shape, "float32")
+    wf = ThresholdedComponentsWorkflow(
+        input_path=path, input_key="raw", output_path=path, output_key="cc",
+        threshold=0.5, tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="inline")
+    assert build([wf], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        assert (f["cc"][...] == 0).all()
