@@ -143,6 +143,10 @@ class _LocalExecutor:
             pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))))
             extra_path = [pkg_parent] + [p for p in sys.path if p]
+            if env.get("JAX_PLATFORMS") == "cpu":
+                # accelerator-plugin site dirs can block backend discovery in
+                # CPU-only workers when their device tunnel is unreachable
+                extra_path = [p for p in extra_path if ".axon_site" not in p]
             prev = env.get("PYTHONPATH")
             if prev:
                 extra_path.append(prev)

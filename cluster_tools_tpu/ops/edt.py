@@ -1,0 +1,83 @@
+"""Exact Euclidean distance transform on device.
+
+TPU-native replacement for vigra's ``distanceTransform`` (the hottest kernel
+of the reference's watershed, watershed/watershed.py:139-158 ``_apply_dt``).
+
+The EDT is separable: with D²(x) the squared distance field, each axis applies
+a min-plus ("tropical") convolution with the quadratic cost (i-j)²·s².  CPU
+implementations use the sequential Felzenszwalb–Huttenlocher lower-envelope
+scan; that is a data-dependent loop a TPU hates.  Instead each axis is a
+**dense min-plus matrix product** against the (n×n) cost matrix, tiled over
+scanlines — O(n) work per voxel but fully vectorized on the VPU with static
+shapes, which wins on TPU for the block sizes the framework uses (reference
+blocks are ~[50, 512, 512], cluster_tasks.py:217).  Exact (not approximate):
+min_j(f(j) + (i-j)²) is computed over all j.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BIG = jnp.float32(1e10)
+
+
+def _minplus_axis(dsq: jnp.ndarray, axis: int, spacing: float,
+                  tile: int = 4096) -> jnp.ndarray:
+    """One axis of the separable EDT: out[..., i] = min_j dsq[..., j] + ((i-j)s)²."""
+    n = dsq.shape[axis]
+    xm = jnp.moveaxis(dsq, axis, -1)
+    lead_shape = xm.shape[:-1]
+    flat = xm.reshape(-1, n)
+    idx = jnp.arange(n, dtype=jnp.float32) * spacing
+    cost = (idx[:, None] - idx[None, :]) ** 2  # (i, j)
+
+    m = flat.shape[0]
+    rows_per_tile = max(tile // max(n, 1), 1)
+    n_tiles = -(-m // rows_per_tile)
+    padded = jnp.pad(flat, ((0, n_tiles * rows_per_tile - m), (0, 0)),
+                     constant_values=0.0)
+    tiles = padded.reshape(n_tiles, rows_per_tile, n)
+
+    def one_tile(t):
+        # (rows, 1, j) + (i, j) -> min over j -> (rows, i)
+        return jnp.min(t[:, None, :] + cost[None, :, :], axis=-1)
+
+    out = jax.lax.map(one_tile, tiles)
+    out = out.reshape(-1, n)[:m]
+    return jnp.moveaxis(out.reshape(*lead_shape, n), -1, axis)
+
+
+@partial(jax.jit, static_argnames=("sampling", "tile"))
+def distance_transform_edt(
+    mask: jnp.ndarray,
+    sampling: Optional[Tuple[float, ...]] = None,
+    tile: int = 65536,
+) -> jnp.ndarray:
+    """Exact EDT of a boolean mask: distance of each foreground (True) voxel
+    to the nearest background voxel (scipy.ndimage.distance_transform_edt
+    convention; vigra's boundaryDistanceTransform differs only in the source
+    set).  ``sampling`` is the per-axis voxel pitch (anisotropy support, used
+    by the reference for 2d-DT over anisotropic EM stacks)."""
+    mask = mask.astype(bool)
+    sampling = sampling or (1.0,) * mask.ndim
+    dsq = jnp.where(mask, _BIG, 0.0).astype(jnp.float32)
+    for ax in range(mask.ndim):
+        dsq = _minplus_axis(dsq, ax, float(sampling[ax]), tile=tile)
+    return jnp.sqrt(dsq)
+
+
+@partial(jax.jit, static_argnames=("sampling", "tile"))
+def signed_distance_transform(
+    mask: jnp.ndarray,
+    sampling: Optional[Tuple[float, ...]] = None,
+    tile: int = 65536,
+) -> jnp.ndarray:
+    """Positive inside the mask, negative outside."""
+    inner = distance_transform_edt(mask, sampling, tile)
+    outer = distance_transform_edt(~mask, sampling, tile)
+    return inner - outer

@@ -1,0 +1,143 @@
+"""Separable image filters on device.
+
+TPU-native replacement for the reference's filter surface (fastfilters/vigra:
+`apply_filter` in utils/volume_utils.py:95, precomputed filter banks in
+features/image_filter.py).  All filters are separable 1-d convolutions
+expressed with ``lax.conv_general_dilated`` so XLA fuses and tiles them; they
+jit, vmap (over blocks / channels) and shard_map (over a device mesh) cleanly.
+
+Boundary handling is reflect-padding, matching vigra's default.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _gaussian_kernel(sigma: float, order: int = 0, truncate: float = 4.0) -> np.ndarray:
+    """1-d Gaussian (or derivative-of-Gaussian) taps, matching scipy's
+    normalization."""
+    radius = max(int(truncate * sigma + 0.5), 1)
+    x = np.arange(-radius, radius + 1, dtype="float64")
+    g = np.exp(-0.5 * (x / sigma) ** 2)
+    g /= g.sum()
+    if order == 0:
+        k = g
+    elif order == 1:
+        k = -x / sigma ** 2 * g
+    elif order == 2:
+        k = (x ** 2 / sigma ** 4 - 1.0 / sigma ** 2) * g
+    else:
+        raise ValueError(f"derivative order {order} not supported")
+    return k.astype("float32")
+
+
+def _conv1d_along(x: jnp.ndarray, taps: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Convolve along one axis with reflect padding (any rank)."""
+    r = (taps.shape[0] - 1) // 2
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (r, r)
+    xp = jnp.pad(x, pad, mode="symmetric")
+    # move target axis last, flatten the rest into a batch for a 1-d conv
+    xm = jnp.moveaxis(xp, axis, -1)
+    lead_shape = xm.shape[:-1]
+    n = xm.shape[-1]
+    flat = xm.reshape(-1, 1, n)  # (batch, channel=1, width)
+    out = jax.lax.conv_general_dilated(
+        flat, taps.reshape(1, 1, -1)[:, :, ::-1],
+        window_strides=(1,), padding="VALID",
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    out = out.reshape(*lead_shape, out.shape[-1])
+    return jnp.moveaxis(out, -1, axis)
+
+
+@partial(jax.jit, static_argnames=("sigma", "truncate"))
+def gaussian(x: jnp.ndarray, sigma: Union[float, Tuple[float, ...]],
+             truncate: float = 4.0) -> jnp.ndarray:
+    """Separable Gaussian smoothing (reference: vigra gaussianSmoothing)."""
+    sigmas = (sigma,) * x.ndim if np.isscalar(sigma) else tuple(sigma)
+    out = x.astype(jnp.float32)
+    for ax, s in enumerate(sigmas):
+        if s > 0:
+            out = _conv1d_along(out, jnp.asarray(_gaussian_kernel(s, 0, truncate)), ax)
+    return out
+
+
+@partial(jax.jit, static_argnames=("sigma",))
+def gaussian_gradient_magnitude(x: jnp.ndarray, sigma: float) -> jnp.ndarray:
+    """|∇(G_sigma * x)| (reference: vigra gaussianGradientMagnitude)."""
+    x = x.astype(jnp.float32)
+    acc = jnp.zeros_like(x)
+    for ax in range(x.ndim):
+        d = x
+        for ax2 in range(x.ndim):
+            order = 1 if ax2 == ax else 0
+            d = _conv1d_along(d, jnp.asarray(_gaussian_kernel(sigma, order)), ax2)
+        acc = acc + d * d
+    return jnp.sqrt(acc)
+
+
+@partial(jax.jit, static_argnames=("sigma",))
+def laplacian_of_gaussian(x: jnp.ndarray, sigma: float) -> jnp.ndarray:
+    """ΔG_sigma * x (reference: vigra laplacianOfGaussian)."""
+    x = x.astype(jnp.float32)
+    acc = jnp.zeros_like(x)
+    for ax in range(x.ndim):
+        d = x
+        for ax2 in range(x.ndim):
+            order = 2 if ax2 == ax else 0
+            d = _conv1d_along(d, jnp.asarray(_gaussian_kernel(sigma, order)), ax2)
+        acc = acc + d
+    return acc
+
+
+@partial(jax.jit, static_argnames=("size", "mode"))
+def rank_pool(x: jnp.ndarray, size: Union[int, Tuple[int, ...]],
+              mode: str = "max") -> jnp.ndarray:
+    """Same-shape max/min filter via reduce_window (reference: scipy
+    maximum_filter / minimum_filter usage in seed detection and min-filter
+    masks, masking/minfilter.py)."""
+    sizes = (size,) * x.ndim if np.isscalar(size) else tuple(size)
+    window = tuple(int(s) for s in sizes)
+    pads = tuple(((w - 1) // 2, w - 1 - (w - 1) // 2) for w in window)
+    if mode == "max":
+        init, op = -jnp.inf, jax.lax.max
+    elif mode == "min":
+        init, op = jnp.inf, jax.lax.min
+    else:
+        raise ValueError(mode)
+    return jax.lax.reduce_window(
+        x.astype(jnp.float32), init, op,
+        window_dimensions=window, window_strides=(1,) * x.ndim,
+        padding=pads)
+
+
+@partial(jax.jit, static_argnames=("radius",))
+def local_maxima(x: jnp.ndarray, radius: int = 1) -> jnp.ndarray:
+    """Boolean mask of local maxima (plateaus included) within a cube window
+    (reference: vigra localMaxima3D, watershed/watershed.py:187)."""
+    return x >= rank_pool(x, 2 * radius + 1, "max")
+
+
+FILTERS = {
+    "gaussianSmoothing": gaussian,
+    "gaussianGradientMagnitude": gaussian_gradient_magnitude,
+    "laplacianOfGaussian": laplacian_of_gaussian,
+}
+
+
+def apply_filter(x: jnp.ndarray, filter_name: str, sigma) -> jnp.ndarray:
+    """By-name dispatch (reference: utils/volume_utils.py:95 apply_filter)."""
+    if filter_name not in FILTERS:
+        raise ValueError(f"unknown filter {filter_name}; have {sorted(FILTERS)}")
+    if filter_name != "gaussianSmoothing" and not np.isscalar(sigma):
+        sigma = float(np.mean(sigma))
+    if filter_name == "gaussianSmoothing" and not np.isscalar(sigma):
+        sigma = tuple(float(s) for s in sigma)
+    return FILTERS[filter_name](x, sigma)

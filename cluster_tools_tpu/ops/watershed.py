@@ -1,0 +1,176 @@
+"""Seeded watershed on device.
+
+TPU-native replacement for vigra's ``watershedsNew`` (reference:
+utils/volume_utils.py:123-139 ``watershed`` + size filter;
+watershed/watershed.py:211-249 per-block 2d/3d watershed).
+
+Sequential priority-flood is inherently serial, so the device algorithm is the
+**steepest-descent forest**: every voxel points to its lowest neighbor (itself
+if it is a local minimum), seeds are forced to point to themselves, and
+pointer jumping (O(log n) gathers) resolves every voxel to a root.  Voxels
+whose root is a seed inherit its label; plateau/non-seed-minimum leftovers are
+filled by monotone label propagation in height order (bounded while_loop that
+at each step adopts the label of the lowest already-labeled neighbor).  The
+result has vigra-compatible *structure* (every masked voxel labeled, seeds
+preserved, boundaries on ridges); exact voxel assignments on plateaus differ
+between implementations, as they already do between vigra and scipy.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from itertools import product
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .components import _neighbor_offsets, _shifted
+
+
+def _flat_offsets(shape: Tuple[int, ...], connectivity: int) -> Tuple[Tuple[int, ...], ...]:
+    return _neighbor_offsets(len(shape), connectivity)
+
+
+@partial(jax.jit, static_argnames=("connectivity", "max_iter"))
+def seeded_watershed(
+    height: jnp.ndarray,
+    seeds: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    connectivity: int = 1,
+    max_iter: int = 0,
+) -> jnp.ndarray:
+    """Grow ``seeds`` (int labels, 0 = unlabeled) over ``height`` (flooded in
+    increasing order) restricted to ``mask``.  Returns int32 labels; 0 only
+    outside the mask."""
+    shape = height.shape
+    n = int(np.prod(shape))
+    height = height.astype(jnp.float32)
+    if mask is None:
+        mask = jnp.ones(shape, bool)
+    else:
+        mask = mask.astype(bool)
+    if max_iter == 0:
+        max_iter = max(2 * int(np.sum(shape)), 32)
+    offsets = _flat_offsets(shape, connectivity)
+
+    big = jnp.float32(np.finfo(np.float32).max)
+    h = jnp.where(mask, height, big)
+    seeded = (seeds > 0) & mask
+    # seeds are below everything: they are the only attractors
+    h = jnp.where(seeded, -big, h)
+
+    flat_idx = jnp.arange(n, dtype=jnp.int32).reshape(shape)
+
+    # steepest-descent pointer: index of the strictly-lowest neighbor
+    # (ties broken toward lower linear index for determinism)
+    best_h = h
+    best_i = flat_idx
+    for off in offsets:
+        nh = _shifted(h, off, big)
+        ni = _shifted(flat_idx, off, jnp.int32(n))
+        better = (nh < best_h) | ((nh == best_h) & (ni < best_i) & (nh < h))
+        best_h = jnp.where(better, nh, best_h)
+        best_i = jnp.where(better, ni, best_i)
+    parent = jnp.where(mask, best_i, flat_idx).reshape(-1)
+    parent = jnp.where(seeded.reshape(-1), jnp.arange(n, dtype=jnp.int32), parent)
+
+    # pointer jumping to roots (bounded: depth halves per step)
+    def jump_body(state):
+        p, _, it = state
+        p2 = p[p]
+        return p2, jnp.any(p2 != p), it + 1
+
+    parent, _, _ = jax.lax.while_loop(
+        lambda s: s[1] & (s[2] < max_iter), jump_body,
+        (parent, jnp.bool_(True), jnp.int32(0)))
+
+    seed_flat = seeds.astype(jnp.int32).reshape(-1)
+    labels = seed_flat[parent]
+    labels = jnp.where(mask.reshape(-1), labels, 0)
+
+    # fill voxels that drained into a non-seed minimum: repeatedly adopt the
+    # label of the lowest labeled neighbor (monotone flooding approximation)
+    hg = jnp.where(mask, height, big)
+
+    def fill_body(state):
+        lab, _, it = state
+        lab_g = lab.reshape(shape)
+        nbr_h = jnp.full(shape, big)
+        nbr_l = jnp.zeros(shape, jnp.int32)
+        for off in offsets:
+            oh = _shifted(hg, off, big)
+            ol = _shifted(lab_g, off, jnp.int32(0))
+            cand = (ol > 0) & (oh < nbr_h)
+            nbr_h = jnp.where(cand, oh, nbr_h)
+            nbr_l = jnp.where(cand, ol, nbr_l)
+        adopt = (lab_g == 0) & mask & (nbr_l > 0)
+        new = jnp.where(adopt, nbr_l, lab_g).reshape(-1)
+        return new, jnp.any(new != lab), it + 1
+
+    labels, _, _ = jax.lax.while_loop(
+        lambda s: s[1] & (s[2] < max_iter), fill_body,
+        (labels, jnp.bool_(True), jnp.int32(0)))
+    return labels.reshape(shape)
+
+
+@partial(jax.jit, static_argnames=("connectivity",))
+def seeded_watershed_batched(
+    heights: jnp.ndarray, seeds: jnp.ndarray, masks: Optional[jnp.ndarray] = None,
+    connectivity: int = 1,
+) -> jnp.ndarray:
+    if masks is None:
+        return jax.vmap(
+            lambda h, s: seeded_watershed(h, s, None, connectivity)
+        )(heights, seeds)
+    return jax.vmap(
+        lambda h, s, m: seeded_watershed(h, s, m, connectivity)
+    )(heights, seeds, masks)
+
+
+def size_filter(
+    labels: np.ndarray, height: np.ndarray, size_threshold: int,
+    mask: Optional[np.ndarray] = None, connectivity: int = 1,
+    per_slice: bool = False,
+) -> np.ndarray:
+    """Remove fragments smaller than ``size_threshold`` and regrow the
+    remaining seeds over the height map (reference:
+    utils/volume_utils.py:123-139 watershed-and-size-filter).  Host-side
+    counting + one device watershed pass.  ``per_slice`` regrows each z-slice
+    independently (2d watershed mode)."""
+    labels = np.asarray(labels)
+    flat = labels.ravel()
+    uniques, inverse, counts = np.unique(flat, return_inverse=True,
+                                         return_counts=True)
+    small = (counts < size_threshold) & (uniques != 0)
+    if not small.any():
+        return labels
+    keep = np.where(small[inverse], 0, flat).reshape(labels.shape)
+    # regrown labels must fit the watershed's int32 seed ids: compact first,
+    # restore original ids after
+    nz = uniques[(uniques != 0) & ~small]
+    seed_ids = np.searchsorted(nz, keep).astype("int32") + 1
+    seed_ids[keep == 0] = 0
+    if per_slice:
+        import jax
+
+        jm = (None if mask is None else jnp.asarray(mask))
+        if jm is None:
+            out = jax.vmap(
+                lambda h, s: seeded_watershed(h, s, None, connectivity)
+            )(jnp.asarray(height), jnp.asarray(seed_ids))
+        else:
+            out = jax.vmap(
+                lambda h, s, m: seeded_watershed(h, s, m, connectivity)
+            )(jnp.asarray(height), jnp.asarray(seed_ids), jm)
+    else:
+        out = seeded_watershed(
+            jnp.asarray(height), jnp.asarray(seed_ids),
+            None if mask is None else jnp.asarray(mask),
+            connectivity=connectivity)
+    out = np.asarray(out)
+    restored = np.zeros(out.shape, dtype=labels.dtype)
+    fg = out > 0
+    restored[fg] = nz[out[fg] - 1]
+    return restored

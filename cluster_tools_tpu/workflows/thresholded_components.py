@@ -170,7 +170,8 @@ class MergeOffsets(BlockTask):
         tmp = cfg["tmp_root"]
         max_ids = np.zeros(cfg["n_blocks"], dtype="uint64")
         for name in os.listdir(tmp):
-            if name.startswith("block_components_max_ids_job_"):
+            if (name.startswith("block_components_max_ids_job_")
+                    and name.endswith(".json")):
                 with open(os.path.join(tmp, name)) as f:
                     for bid, mx in json.load(f).items():
                         max_ids[int(bid)] = mx
@@ -269,7 +270,8 @@ class MergeAssignments(BlockTask):
             n_labels = json.load(f)["n_labels"]
         pair_arrays = []
         for name in os.listdir(cfg["tmp_root"]):
-            if name.startswith("block_faces_assignments_job_"):
+            if (name.startswith("block_faces_assignments_job_")
+                    and name.endswith(".npy")):
                 pair_arrays.append(
                     np.load(os.path.join(cfg["tmp_root"], name)))
         pairs = (np.concatenate(pair_arrays, axis=0) if pair_arrays

@@ -1,0 +1,254 @@
+"""Blockwise distance-transform watershed.
+
+Re-specification of the reference's ``watershed/`` package
+(watershed/watershed.py): per block (with halo) — read boundary/affinity map,
+threshold + Euclidean distance transform, seeds from smoothed-DT maxima,
+seeded watershed on a height map mixing boundary evidence and inverted DT,
+size filter, per-block label offset, write inner block.  All pixel compute
+runs on device (ops/edt.py, ops/filters.py, ops/watershed.py); under
+``target='tpu'`` the whole per-block pipeline is one jitted program.
+
+2d variants (``apply_dt_2d`` / ``apply_ws_2d``, for anisotropic EM stacks)
+process z-slices via vmap over the z axis — the reference loops slices in
+Python (watershed.py:211-230); here it is one batched device call.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.blocking import Blocking
+from ..core.runtime import BlockTask
+from ..core.storage import file_reader
+from ..core.workflow import Task
+from .relabel import RelabelWorkflow
+
+
+def _read_input(ds, bb, cfg) -> np.ndarray:
+    """Read + normalize boundary evidence; agglomerate affinity channels by
+    mean/max over the configured channel range (reference:
+    watershed.py:267-283 _read_data)."""
+    if ds.ndim == len(bb) + 1:
+        chan = cfg.get("channel_begin", 0), cfg.get("channel_end", None)
+        cb = chan[0]
+        ce = ds.shape[0] if chan[1] is None else chan[1]
+        data = ds[(slice(cb, ce),) + bb].astype("float32")
+        agglo = cfg.get("agglomerate_channels", "mean")
+        data = data.max(axis=0) if agglo == "max" else data.mean(axis=0)
+    else:
+        data = ds[bb].astype("float32")
+    if data.dtype != np.float32 or data.max() > 1.0:
+        mx = data.max()
+        if mx > 1.0:
+            data = data / 255.0 if mx <= 255 else data / mx
+    if cfg.get("invert_inputs", False):
+        data = 1.0 - data
+    return data
+
+
+def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
+                 mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """The per-block watershed pipeline (reference: _ws_block
+    watershed.py:285-341), device compute with host glue."""
+    import jax.numpy as jnp
+
+    from ..ops.components import connected_components
+    from ..ops.edt import distance_transform_edt
+    from ..ops.filters import gaussian, local_maxima
+    from ..ops.watershed import seeded_watershed, size_filter
+
+    import jax
+
+    threshold = cfg.get("threshold", 0.25)
+    sigma_seeds = cfg.get("sigma_seeds", 2.0)
+    sigma_weights = cfg.get("sigma_weights", 2.0)
+    min_size = cfg.get("size_filter", 25)
+    alpha = cfg.get("alpha", 0.8)
+    pixel_pitch = cfg.get("pixel_pitch")
+    dt_2d = cfg.get("apply_dt_2d", False)
+    ws_2d = cfg.get("apply_ws_2d", False)
+
+    x = jnp.asarray(data)
+    jmask = None if mask is None else jnp.asarray(mask.astype(bool))
+
+    # distance to boundaries (vigra distanceTransform equivalent)
+    fg = x < threshold
+    if jmask is not None:
+        fg = fg & jmask
+    if dt_2d or ws_2d:
+        dt = jax.vmap(lambda m: distance_transform_edt(m))(fg)
+    else:
+        sampling = tuple(pixel_pitch) if pixel_pitch else None
+        dt = distance_transform_edt(fg, sampling=sampling)
+
+    # height map: boundary evidence blended with inverted DT
+    # (reference fit_to_hmap/_make_hmap, utils/volume_utils.py:294-391)
+    hmap = gaussian(x, sigma_weights) if sigma_weights else x
+    dmax = jnp.maximum(dt.max(), 1e-6)
+    height = alpha * hmap + (1.0 - alpha) * (1.0 - dt / dmax)
+
+    if ws_2d:
+        # independent watershed per z-slice (reference: watershed.py:211-230
+        # loops slices; here one vmapped device program).  Per-slice labels
+        # are made unique across slices by a per-slice offset.
+        dt_smooth = (jax.vmap(lambda d: gaussian(d, sigma_seeds))(dt)
+                     if sigma_seeds else dt)
+        maxima = jax.vmap(lambda d, f: local_maxima(d, 2) & f)(dt_smooth, fg)
+        seeds = jax.vmap(lambda m: connected_components(m, connectivity=2))(maxima)
+        if jmask is None:
+            ws = jax.vmap(
+                lambda h, s: seeded_watershed(h, s, None, connectivity=1)
+            )(height, seeds)
+        else:
+            ws = jax.vmap(
+                lambda h, s, m: seeded_watershed(h, s, m, connectivity=1)
+            )(height, seeds, jmask)
+        slice_size = int(np.prod(data.shape[1:]))
+        offsets = (jnp.arange(data.shape[0], dtype=jnp.int64)
+                   * slice_size)[:, None, None]
+        ws = jnp.where(ws > 0, ws.astype(jnp.int64) + offsets, 0)
+        ws = np.array(ws)
+    else:
+        # seeds: connected maxima clusters of the smoothed DT
+        dt_smooth = gaussian(dt, sigma_seeds) if sigma_seeds else dt
+        maxima = local_maxima(dt_smooth, radius=2) & fg
+        seeds = connected_components(maxima, connectivity=len(data.shape))
+        ws = np.array(seeded_watershed(height, seeds, jmask, connectivity=1))
+    if min_size:
+        ws = size_filter(ws, np.asarray(height), min_size,
+                         mask=None if mask is None else mask.astype(bool),
+                         per_slice=ws_2d)
+    return ws.astype("uint64")
+
+
+class WatershedTask(BlockTask):
+    """Blockwise DT watershed (reference: WatershedBase, watershed.py:34-110).
+
+    Labels are made globally unique by offsetting with
+    ``block_id * prod(block_shape)`` (reference: watershed.py:307); chain
+    RelabelWorkflow (or use WatershedWorkflow) to compact them.
+    """
+
+    task_name = "watershed"
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, mask_path: str = "", mask_key: str = "", **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({
+            "threshold": 0.25, "apply_dt_2d": False, "apply_ws_2d": False,
+            "sigma_seeds": 2.0, "sigma_weights": 2.0, "size_filter": 25,
+            "alpha": 0.8, "halo": [4, 32, 32], "pixel_pitch": None,
+            "invert_inputs": False, "agglomerate_channels": "mean",
+            "channel_begin": 0, "channel_end": None,
+        })
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            in_shape = f[self.input_key].shape
+        shape = list(in_shape[1:] if len(in_shape) == 4 else in_shape)
+        block_shape = self.global_block_shape()[-len(shape):]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=shape, chunks=block_shape,
+                              dtype="uint64")
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "mask_path": self.mask_path, "mask_key": self.mask_key,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        halo = cfg.get("halo") or [0] * blocking.ndim
+        halo = halo[-blocking.ndim:]
+        f_in = file_reader(cfg["input_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
+        mask = None
+        if cfg.get("mask_path"):
+            from ..core.volume_views import load_mask
+
+            mask = load_mask(cfg["mask_path"], cfg["mask_key"], cfg["shape"])
+
+        label_offset_unit = np.uint64(np.prod(cfg["block_shape"]))
+        for block_id in job_config["block_list"]:
+            bh = blocking.get_block_with_halo(block_id, halo)
+            data = _read_input(ds_in, bh.outer.bb, cfg)
+            bmask = None
+            if mask is not None:
+                bmask = np.asarray(mask[bh.outer.bb]) > 0
+                if not bmask.any():
+                    log_fn(f"processed block {block_id}")
+                    continue
+            ws = run_ws_block(data, cfg, bmask)
+            inner = ws[bh.inner_local.bb]
+            # compact to 1..k (k <= inner voxel count < offset unit), THEN
+            # offset for global uniqueness (reference: watershed.py:307) —
+            # uncompacted CC root indices range over the larger outer block
+            # and would collide across blocks
+            nonzero = np.unique(inner[inner > 0])
+            compact = np.searchsorted(nonzero, inner).astype("uint64") + 1
+            compact[inner == 0] = 0
+            compact = np.where(
+                compact > 0, compact + np.uint64(block_id) * label_offset_unit, 0)
+            ds_out[bh.inner.bb] = compact
+            log_fn(f"processed block {block_id}")
+
+
+class WatershedWorkflow(Task):
+    """Watershed -> RelabelWorkflow (reference:
+    watershed/watershed_workflow.py:20-60; agglomeration step arrives with the
+    graph stack)."""
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, tmp_folder: str, config_dir: str,
+                 max_jobs: int = 1, target: str = "local",
+                 mask_path: str = "", mask_key: str = "",
+                 dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def requires(self):
+        common = dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                      max_jobs=self.max_jobs, target=self.target)
+        ws = WatershedTask(
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key=self.output_key,
+            mask_path=self.mask_path, mask_key=self.mask_key,
+            dependency=self.dependency, **common)
+        return RelabelWorkflow(
+            input_path=self.output_path, input_key=self.output_key,
+            identifier="relabel_ws", dependency=ws, **common)
+
+    def output(self):
+        from ..core.workflow import FileTarget
+
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "write_relabel_ws.status"))

@@ -36,13 +36,31 @@ def load_assignments(path: str, key: Optional[str]) -> np.ndarray:
     else:
         with file_reader(path, "r") as f:
             table = f[key][...]
-    if table.ndim == 2:
-        # pairwise (id, new_id) rows -> dense
-        n = int(table[:, 0].max()) + 1
-        dense = np.zeros(n, dtype="uint64")
-        dense[table[:, 0].astype("int64")] = table[:, 1]
-        table = dense
+    if table.ndim == 2 and table.shape[1] == 2:
+        # pairwise (id, new_id) rows; keep sparse (ids can be huge after
+        # per-block offsetting: block_id * prod(block_shape), reference
+        # watershed.py:307) and apply via searchsorted
+        order = np.argsort(table[:, 0], kind="stable")
+        table = table[order]
     return table.astype("uint64", copy=False)
+
+
+def apply_assignment_table(seg: np.ndarray, table: np.ndarray) -> np.ndarray:
+    """Apply a dense (1d lookup) or sparse (sorted (id, new_id) pairs)
+    assignment table to a fragment array (reference: nifty.tools.takeDict /
+    take usage in write/_apply_node_labels)."""
+    if table.ndim == 1:
+        if seg.max() >= table.size:
+            raise ValueError(
+                f"fragment id {int(seg.max())} outside assignment table "
+                f"of size {table.size}")
+        return table[seg]
+    idx = np.searchsorted(table[:, 0], seg)
+    if (idx >= table.shape[0]).any() or (table[idx.ravel(), 0] != seg.ravel()).any():
+        missing = seg.ravel()[table[np.minimum(idx.ravel(), table.shape[0] - 1), 0]
+                              != seg.ravel()][:5]
+        raise ValueError(f"fragment ids missing from sparse table: {missing}")
+    return table[idx, 1]
 
 
 class WriteAssignments(BlockTask):
@@ -98,8 +116,9 @@ class WriteAssignments(BlockTask):
         }, n_jobs=self.max_jobs)
         # maxId attribute for downstream consumers (reference: write.py:269-277)
         table = load_assignments(self.assignment_path, self.assignment_key)
+        max_id = int(table[:, 1].max()) if table.ndim == 2 else int(table.max())
         with file_reader(self.output_path) as f:
-            f[self.output_key].attrs["maxId"] = int(table.max())
+            f[self.output_key].attrs["maxId"] = max_id
 
     @classmethod
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
@@ -121,9 +140,5 @@ class WriteAssignments(BlockTask):
             if offsets is not None:
                 off = np.uint64(offsets[block_id])
                 seg[seg != 0] += off
-            if seg.max() >= table.size:
-                raise ValueError(
-                    f"block {block_id}: fragment id {int(seg.max())} outside "
-                    f"assignment table of size {table.size}")
-            ds_out[bb] = table[seg]
+            ds_out[bb] = apply_assignment_table(seg, table)
             log_fn(f"processed block {block_id}")
