@@ -1,0 +1,1 @@
+from .unet import UNet3D, create_unet, DEFAULT_OFFSETS

@@ -52,7 +52,11 @@ def seeded_watershed(
     else:
         mask = mask.astype(bool)
     if max_iter == 0:
-        max_iter = max(2 * int(np.sum(shape)), 32)
+        # the fill loop advances labels one voxel per iteration along geodesic
+        # paths, so the only safe data-independent bound is the voxel count
+        # (serpentine corridors realize it); both loops exit early on
+        # convergence, so the generous bound costs nothing in practice
+        max_iter = max(n, 32)
     offsets = _flat_offsets(shape, connectivity)
 
     big = jnp.float32(np.finfo(np.float32).max)
@@ -153,17 +157,10 @@ def size_filter(
     seed_ids = np.searchsorted(nz, keep).astype("int32") + 1
     seed_ids[keep == 0] = 0
     if per_slice:
-        import jax
-
-        jm = (None if mask is None else jnp.asarray(mask))
-        if jm is None:
-            out = jax.vmap(
-                lambda h, s: seeded_watershed(h, s, None, connectivity)
-            )(jnp.asarray(height), jnp.asarray(seed_ids))
-        else:
-            out = jax.vmap(
-                lambda h, s, m: seeded_watershed(h, s, m, connectivity)
-            )(jnp.asarray(height), jnp.asarray(seed_ids), jm)
+        out = seeded_watershed_batched(
+            jnp.asarray(height), jnp.asarray(seed_ids),
+            None if mask is None else jnp.asarray(mask),
+            connectivity=connectivity)
     else:
         out = seeded_watershed(
             jnp.asarray(height), jnp.asarray(seed_ids),

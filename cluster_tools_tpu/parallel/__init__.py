@@ -1,0 +1,2 @@
+from .mesh import make_mesh, volume_sharding, param_sharding, replicated
+from .stencil import halo_exchange, crop_halo, sharded_stencil

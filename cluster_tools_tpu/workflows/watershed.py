@@ -40,10 +40,9 @@ def _read_input(ds, bb, cfg) -> np.ndarray:
         data = data.max(axis=0) if agglo == "max" else data.mean(axis=0)
     else:
         data = ds[bb].astype("float32")
-    if data.dtype != np.float32 or data.max() > 1.0:
-        mx = data.max()
-        if mx > 1.0:
-            data = data / 255.0 if mx <= 255 else data / mx
+    mx = data.max()
+    if mx > 1.0:
+        data = data / 255.0 if mx <= 255 else data / mx
     if cfg.get("invert_inputs", False):
         data = 1.0 - data
     return data
@@ -58,7 +57,8 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
     from ..ops.components import connected_components
     from ..ops.edt import distance_transform_edt
     from ..ops.filters import gaussian, local_maxima
-    from ..ops.watershed import seeded_watershed, size_filter
+    from ..ops.watershed import (seeded_watershed, seeded_watershed_batched,
+                                 size_filter)
 
     import jax
 
@@ -98,19 +98,14 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
                      if sigma_seeds else dt)
         maxima = jax.vmap(lambda d, f: local_maxima(d, 2) & f)(dt_smooth, fg)
         seeds = jax.vmap(lambda m: connected_components(m, connectivity=2))(maxima)
-        if jmask is None:
-            ws = jax.vmap(
-                lambda h, s: seeded_watershed(h, s, None, connectivity=1)
-            )(height, seeds)
-        else:
-            ws = jax.vmap(
-                lambda h, s, m: seeded_watershed(h, s, m, connectivity=1)
-            )(height, seeds, jmask)
-        slice_size = int(np.prod(data.shape[1:]))
-        offsets = (jnp.arange(data.shape[0], dtype=jnp.int64)
+        ws = seeded_watershed_batched(height, seeds, jmask, connectivity=1)
+        # per-slice offsets in host uint64: device int32 would overflow for
+        # n_slices * slice_size >= 2**31 (large in-plane blocks)
+        ws = np.array(ws).astype(np.uint64)
+        slice_size = np.uint64(np.prod(data.shape[1:]))
+        offsets = (np.arange(data.shape[0], dtype=np.uint64)
                    * slice_size)[:, None, None]
-        ws = jnp.where(ws > 0, ws.astype(jnp.int64) + offsets, 0)
-        ws = np.array(ws)
+        ws = np.where(ws > 0, ws + offsets, 0)
     else:
         # seeds: connected maxima clusters of the smoothed DT
         dt_smooth = gaussian(dt, sigma_seeds) if sigma_seeds else dt

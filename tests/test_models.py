@@ -1,0 +1,63 @@
+"""Flagship model + sharded training step (8-device virtual mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_unet_forward_shape():
+    from cluster_tools_tpu.models.unet import create_unet
+
+    model = create_unet(out_channels=3, features=(4, 8), anisotropic=False)
+    x = jnp.zeros((1, 8, 16, 16, 1), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = jax.jit(model.apply)(params, x)
+    assert out.shape == (1, 8, 16, 16, 3)
+    assert np.all((np.array(out) >= 0) & (np.array(out) <= 1))  # sigmoid
+
+
+def test_mesh_factorization():
+    from cluster_tools_tpu.parallel.mesh import _factorize
+
+    assert _factorize(8) == (2, 2, 2)
+    assert _factorize(4) == (2, 2, 1)
+    assert _factorize(2) == (2, 1, 1)
+    assert _factorize(1) == (1, 1, 1)
+    assert np.prod(_factorize(6)) == 6
+
+
+def test_sharded_train_step_runs_and_learns():
+    from cluster_tools_tpu.models.train import train_step_for_mesh
+
+    jitted, state, (x, y) = train_step_for_mesh(
+        n_devices=8, features=(4, 8), shape=(2, 8, 16, 16))
+    state, loss0 = jitted(state, x, y)
+    for _ in range(3):
+        state, loss = jitted(state, x, y)
+    assert np.isfinite(float(loss0))
+    assert float(loss) < float(loss0)  # optimizer is actually stepping
+
+
+def test_halo_exchange_matches_padded_stencil():
+    """sharded_stencil(mean filter) == the same stencil on the full array."""
+    from jax.sharding import Mesh
+
+    from cluster_tools_tpu.parallel.stencil import sharded_stencil
+
+    devices = np.array(jax.devices()[:4])
+    mesh = Mesh(devices, ("space",))
+
+    def local_mean(x):  # 3-tap mean along axis 0
+        return (jnp.roll(x, 1, 0) + x + jnp.roll(x, -1, 0)) / 3.0
+
+    rng = np.random.RandomState(0)
+    full = rng.rand(16, 5).astype(np.float32)
+
+    apply = sharded_stencil(lambda x: local_mean(x), mesh, halo=1, axis=0,
+                            mesh_axis="space")
+    out = np.array(apply(jnp.asarray(full)))
+
+    padded = np.pad(full, ((1, 1), (0, 0)))
+    expect = (padded[:-2] + padded[1:-1] + padded[2:]) / 3.0
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
