@@ -1,0 +1,264 @@
+"""Distributed region-adjacency-graph construction.
+
+Re-specification of the reference's ``graph/`` package (SURVEY §2.1):
+per-block sub-graphs -> hierarchical merge over scales -> global graph ->
+block-edge -> global-edge id mapping.  The reference delegates each step to
+``nifty.distributed`` C++ (initial_sub_graphs.py:114-118 ndist.
+computeMergeableRegionGraph, merge_sub_graphs.py:133-141 ndist.mergeSubgraphs,
+map_edge_ids.py:95-118 ndist.mapEdgeIds); here blocks are extracted by a
+jitted device kernel (ops/rag.py) and merged with vectorized host set ops
+(core/graph.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core import graph as g
+from ..core.blocking import Blocking
+from ..core.runtime import BlockTask
+from ..core.storage import file_reader
+from ..core.workflow import Task
+
+
+class InitialSubGraphs(BlockTask):
+    """Per-block RAG extraction (reference: InitialSubGraphs,
+    initial_sub_graphs.py:21).  Reads the label block with a +1 upper-face
+    halo (increaseRoi) so every inter-block face is owned exactly once."""
+
+    task_name = "initial_sub_graphs"
+
+    def __init__(self, input_path: str, input_key: str, graph_path: str,
+                 **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.graph_path = graph_path
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"ignore_label": True})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            shape = list(f[self.input_key].shape)
+        block_shape = self.global_block_shape()
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "graph_path": self.graph_path,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        import jax.numpy as jnp
+
+        from ..ops.rag import label_pairs
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        ignore_label = bool(cfg.get("ignore_label", True))
+        f = file_reader(cfg["input_path"], "r")
+        ds = f[cfg["input_key"]]
+        for block_id in job_config["block_list"]:
+            block = blocking.get_block(block_id)
+            # +1 halo on upper faces only, clipped at the volume border
+            end = [min(e + 1, s) for e, s in zip(block.end, cfg["shape"])]
+            bb = tuple(slice(b, e) for b, e in zip(block.begin, end))
+            labels = ds[bb]
+            u, v, ok = label_pairs(jnp.asarray(labels.astype("int64")),
+                                   ignore_label=ignore_label,
+                                   inner_shape=tuple(block.shape))
+            m = np.asarray(ok)
+            edges = g.unique_edges(np.asarray(u)[m], np.asarray(v)[m])
+            nodes = np.unique(labels)
+            if ignore_label:
+                nodes = nodes[nodes != 0]
+            g.save_sub_graph(cfg["graph_path"], 0, block_id,
+                             nodes.astype("uint64"), edges)
+            log_fn(f"processed block {block_id}")
+
+
+class MergeSubGraphs(BlockTask):
+    """Hierarchical union of child sub-graphs (reference: MergeSubGraphs,
+    merge_sub_graphs.py).  At scale s, one merged block covers 2**s base
+    blocks per axis; with ``merge_complete_graph`` the single top job writes
+    the global graph dataset."""
+
+    task_name = "merge_sub_graphs"
+
+    def __init__(self, graph_path: str, scale: int,
+                 merge_complete_graph: bool = False, output_key: str = "graph",
+                 input_path: str = "", input_key: str = "", **kw):
+        self.graph_path = graph_path
+        self.scale = scale
+        self.merge_complete_graph = merge_complete_graph
+        self.output_key = output_key
+        self.input_path = input_path
+        self.input_key = input_key
+        self.identifier = f"s{scale}" + ("_full" if merge_complete_graph else "")
+        super().__init__(**kw)
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            shape = list(f[self.input_key].shape)
+        base_bs = self.global_block_shape()
+        if self.merge_complete_graph:
+            self.run_jobs(None, {
+                "graph_path": self.graph_path, "scale": self.scale,
+                "shape": shape, "block_shape": base_bs,
+                "merge_complete_graph": True, "output_key": self.output_key,
+                "ignore_label": True,
+            })
+            return
+        factor = 2 ** self.scale
+        scale_bs = [b * factor for b in base_bs]
+        block_list = self.blocks_in_volume(shape, scale_bs)
+        self.run_jobs(block_list, {
+            "graph_path": self.graph_path, "scale": self.scale,
+            "shape": shape, "block_shape": base_bs,
+            "merge_complete_graph": False, "output_key": self.output_key,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        scale = int(cfg["scale"])
+        base_bs = cfg["block_shape"]
+        shape = cfg["shape"]
+        graph_path = cfg["graph_path"]
+
+        if cfg.get("merge_complete_graph"):
+            # union every sub-graph at `scale` (scale may be 0: union of all
+            # initial blocks)
+            src_blocking = (Blocking(shape, [b * 2 ** scale for b in base_bs])
+                            if scale > 0 else Blocking(shape, base_bs))
+            read_scale = scale
+            edge_lists = []
+            node_lists = []
+            for bid in range(src_blocking.n_blocks):
+                data = g.load_sub_graph(graph_path, read_scale, bid)
+                edge_lists.append(data["edges"])
+                node_lists.append(data["nodes"])
+            edges = g.merge_edge_lists(edge_lists)
+            nodes = (np.unique(np.concatenate([n for n in node_lists if len(n)]))
+                     if any(len(n) for n in node_lists) else np.zeros(0, "uint64"))
+            g.save_graph(graph_path, cfg["output_key"], nodes, edges, shape,
+                         ignore_label=bool(cfg.get("ignore_label", True)))
+            log_fn(f"global graph: {len(nodes)} nodes, {len(edges)} edges")
+            return
+
+        child_blocking = Blocking(shape, [b * 2 ** (scale - 1) for b in base_bs])
+        merged_blocking = Blocking(shape, [b * 2 ** scale for b in base_bs])
+        for block_id in job_config["block_list"]:
+            block = merged_blocking.get_block(block_id)
+            child_ids = child_blocking.blocks_in_roi(block.begin, block.end)
+            edge_lists, node_lists = [], []
+            for cid in child_ids:
+                data = g.load_sub_graph(graph_path, scale - 1, cid)
+                edge_lists.append(data["edges"])
+                node_lists.append(data["nodes"])
+            edges = g.merge_edge_lists(edge_lists)
+            nodes = (np.unique(np.concatenate([n for n in node_lists if len(n)]))
+                     if any(len(n) for n in node_lists) else np.zeros(0, "uint64"))
+            g.save_sub_graph(graph_path, scale, block_id, nodes, edges)
+            log_fn(f"processed block {block_id}")
+
+
+class MapEdgeIds(BlockTask):
+    """Map per-block edges to global edge ids at one scale (reference:
+    MapEdgeIds, map_edge_ids.py:95-118)."""
+
+    task_name = "map_edge_ids"
+
+    def __init__(self, graph_path: str, scale: int, graph_key: str = "graph",
+                 input_path: str = "", input_key: str = "", **kw):
+        self.graph_path = graph_path
+        self.scale = scale
+        self.graph_key = graph_key
+        self.input_path = input_path
+        self.input_key = input_key
+        self.identifier = f"s{scale}"
+        super().__init__(**kw)
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            shape = list(f[self.input_key].shape)
+        base_bs = self.global_block_shape()
+        scale_bs = [b * 2 ** self.scale for b in base_bs]
+        block_list = self.blocks_in_volume(shape, scale_bs)
+        self.run_jobs(block_list, {
+            "graph_path": self.graph_path, "scale": self.scale,
+            "graph_key": self.graph_key,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        _, global_edges, _ = g.load_graph(cfg["graph_path"], cfg["graph_key"])
+        for block_id in job_config["block_list"]:
+            data = g.load_sub_graph(cfg["graph_path"], cfg["scale"], block_id)
+            edge_ids = g.find_edge_ids(global_edges, data["edges"])
+            g.save_sub_graph(cfg["graph_path"], cfg["scale"], block_id,
+                             data["nodes"], data["edges"], edge_ids)
+            log_fn(f"processed block {block_id}")
+
+
+class GraphWorkflow(Task):
+    """InitialSubGraphs -> MergeSubGraphs (scales) -> final merge ->
+    MapEdgeIds per scale (reference: graph_workflow.py:22-64)."""
+
+    def __init__(self, input_path: str, input_key: str, graph_path: str,
+                 tmp_folder: str, config_dir: str, max_jobs: int = 1,
+                 target: str = "local", n_scales: int = 1,
+                 output_key: str = "graph", dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.graph_path = graph_path
+        self.n_scales = n_scales
+        self.output_key = output_key
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def _common(self):
+        return dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                    max_jobs=self.max_jobs, target=self.target)
+
+    def requires(self):
+        dep = InitialSubGraphs(
+            input_path=self.input_path, input_key=self.input_key,
+            graph_path=self.graph_path, dependency=self.dependency,
+            **self._common())
+        for scale in range(1, self.n_scales):
+            dep = MergeSubGraphs(
+                graph_path=self.graph_path, scale=scale,
+                input_path=self.input_path, input_key=self.input_key,
+                dependency=dep, **self._common())
+        dep = MergeSubGraphs(
+            graph_path=self.graph_path, scale=self.n_scales - 1,
+            merge_complete_graph=True, output_key=self.output_key,
+            input_path=self.input_path, input_key=self.input_key,
+            dependency=dep, **self._common())
+        for scale in range(self.n_scales):
+            dep = MapEdgeIds(
+                graph_path=self.graph_path, scale=scale,
+                graph_key=self.output_key,
+                input_path=self.input_path, input_key=self.input_key,
+                dependency=dep, **self._common())
+        return dep
+
+    def output(self):
+        from ..core.workflow import FileTarget
+
+        return FileTarget(os.path.join(
+            self.tmp_folder, f"map_edge_ids_s{self.n_scales - 1}.status"))

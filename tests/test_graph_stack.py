@@ -1,0 +1,203 @@
+"""Graph -> features -> costs stack vs brute-force numpy oracles."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _toy_labels(shape=(16, 16, 16), n_seeds=12, seed=0):
+    """Voronoi labeling: dense supervoxel-like segmentation, labels 1..n."""
+    rng = np.random.RandomState(seed)
+    pts = rng.rand(n_seeds, 3) * np.array(shape)
+    grids = np.meshgrid(*[np.arange(s) for s in shape], indexing="ij")
+    coords = np.stack(grids, -1).astype("float32")
+    d = np.stack([np.linalg.norm(coords - p, axis=-1) for p in pts])
+    return (np.argmin(d, axis=0) + 1).astype("uint64")
+
+
+def _brute_force_rag(labels, ignore_label=True):
+    pairs = []
+    for axis in range(labels.ndim):
+        a = np.moveaxis(labels, axis, 0)[:-1].ravel()
+        b = np.moveaxis(labels, axis, 0)[1:].ravel()
+        m = a != b
+        if ignore_label:
+            m &= (a != 0) & (b != 0)
+        pairs.append(np.stack([np.minimum(a[m], b[m]),
+                               np.maximum(a[m], b[m])], 1))
+    return np.unique(np.concatenate(pairs), axis=0)
+
+
+def _write_volume(path, key, data, chunks):
+    from cluster_tools_tpu.core.storage import file_reader
+
+    with file_reader(path) as f:
+        ds = f.require_dataset(key, shape=data.shape, chunks=chunks,
+                               dtype=str(data.dtype))
+        ds[:] = data
+
+
+@pytest.fixture()
+def graph_setup(tmp_path, tmp_workdir):
+    tmp_folder, config_dir = tmp_workdir
+    labels = _toy_labels()
+    path = str(tmp_path / "data.n5")
+    _write_volume(path, "labels", labels, (10, 10, 10))
+    return labels, path, tmp_folder, config_dir
+
+
+def test_graph_workflow_matches_bruteforce(graph_setup, tmp_path):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.graph import load_graph, load_sub_graph
+    from cluster_tools_tpu.workflows.graph import GraphWorkflow
+
+    labels, path, tmp_folder, config_dir = graph_setup
+    graph_path = str(tmp_path / "graph.n5")
+    wf = GraphWorkflow(input_path=path, input_key="labels",
+                       graph_path=graph_path, tmp_folder=tmp_folder,
+                       config_dir=config_dir, max_jobs=2, target="threads",
+                       n_scales=2)
+    assert ctt.build([wf])
+    nodes, edges, attrs = load_graph(graph_path, "graph")
+    expect = _brute_force_rag(labels)
+    np.testing.assert_array_equal(edges, expect)
+    np.testing.assert_array_equal(nodes, np.unique(labels))
+    # per-block sub-graph edges must carry valid global edge ids
+    sub = load_sub_graph(graph_path, 0, 0)
+    assert "edge_ids" in sub
+    np.testing.assert_array_equal(edges[sub["edge_ids"]], sub["edges"])
+
+
+def test_edge_features_match_bruteforce(graph_setup, tmp_path):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.graph import load_graph
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.workflows.features import EdgeFeaturesWorkflow
+    from cluster_tools_tpu.workflows.graph import GraphWorkflow
+
+    labels, path, tmp_folder, config_dir = graph_setup
+    rng = np.random.RandomState(1)
+    bmap = rng.rand(*labels.shape).astype("float32")
+    _write_volume(path, "boundaries", bmap, (10, 10, 10))
+    graph_path = str(tmp_path / "graph.n5")
+    feat_path = str(tmp_path / "features.n5")
+
+    wf = GraphWorkflow(input_path=path, input_key="labels",
+                       graph_path=graph_path, tmp_folder=tmp_folder,
+                       config_dir=config_dir, max_jobs=2, target="threads")
+    fw = EdgeFeaturesWorkflow(
+        input_path=path, input_key="boundaries", labels_path=path,
+        labels_key="labels", graph_path=graph_path, output_path=feat_path,
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=2,
+        target="threads", dependency=wf)
+    assert ctt.build([fw])
+
+    _, edges, _ = load_graph(graph_path, "graph")
+    with file_reader(feat_path, "r") as f:
+        feats = f["features"][:]
+
+    # oracle: pool both face voxels per axis-neighbor pair
+    samples = {}
+    for axis in range(3):
+        a = np.moveaxis(labels, axis, 0)[:-1]
+        b = np.moveaxis(labels, axis, 0)[1:]
+        va = np.moveaxis(bmap, axis, 0)[:-1]
+        vb = np.moveaxis(bmap, axis, 0)[1:]
+        m = a != b
+        for u, v, x, y in zip(a[m], b[m], va[m], vb[m]):
+            key = (min(u, v), max(u, v))
+            samples.setdefault(key, []).extend([x, y])
+    for i, (u, v) in enumerate(edges):
+        vals = np.array(samples[(u, v)], dtype="float64")
+        assert feats[i, 9] == len(vals)
+        np.testing.assert_allclose(feats[i, 0], vals.mean(), rtol=1e-6)
+        np.testing.assert_allclose(feats[i, 2], vals.min(), rtol=1e-6)
+        np.testing.assert_allclose(feats[i, 8], vals.max(), rtol=1e-6)
+        np.testing.assert_allclose(feats[i, 1], vals.var(), rtol=1e-5,
+                                   atol=1e-12)
+
+
+def test_probs_to_costs_formula():
+    from cluster_tools_tpu.workflows.costs import (
+        transform_probabilities_to_costs)
+
+    p = np.array([0.0, 0.1, 0.5, 0.9, 1.0], "float32")
+    c = transform_probabilities_to_costs(p, beta=0.5)
+    pc = np.clip((1 - 0.002) * p + 0.001, 0.001, 0.999)
+    expect = np.log((1 - pc) / pc)
+    np.testing.assert_allclose(c, expect, rtol=1e-4)
+    assert c[0] > 0 and c[-1] < 0  # low prob -> attractive, high -> repulsive
+
+    sizes = np.array([1, 2, 4, 8, 8], "float32")
+    cw = transform_probabilities_to_costs(p, beta=0.5, edge_sizes=sizes)
+    np.testing.assert_allclose(cw, expect * sizes / 8.0, rtol=1e-4)
+
+
+def test_apply_node_labels_modes():
+    from cluster_tools_tpu.workflows.costs import apply_node_labels
+
+    uv = np.array([[0, 1], [1, 2], [2, 3]], "uint64")
+    labels = np.array([0, 1, 1, 0], "uint64")
+    c = np.zeros(3, "float32")
+    out = apply_node_labels(c.copy(), uv, "ignore", labels, -10, 10)
+    np.testing.assert_array_equal(out, [-10, -10, -10])
+    out = apply_node_labels(c.copy(), uv, "isolate", labels, -10, 10)
+    np.testing.assert_array_equal(out, [-10, 10, -10])
+    labels2 = np.array([1, 1, 2, 2], "uint64")
+    out = apply_node_labels(c.copy(), uv, "ignore_transition", labels2, -10, 10)
+    np.testing.assert_array_equal(out, [0, -10, 0])
+
+
+def test_affinity_features_keep_seam_edges(graph_setup, tmp_path):
+    """Affinity anchors owned by the neighbor block must still contribute to
+    seam edges (regression: samples were dropped when the anchor's block did
+    not own the edge)."""
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.graph import load_graph
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.workflows.features import EdgeFeaturesWorkflow
+    from cluster_tools_tpu.workflows.graph import GraphWorkflow
+
+    labels, path, tmp_folder, config_dir = graph_setup
+    offsets = [[-1, 0, 0], [0, -1, 0], [0, 0, -1]]
+    rng = np.random.RandomState(2)
+    affs = rng.rand(3, *labels.shape).astype("float32")
+    _write_volume(path, "affs", affs, (3, 10, 10, 10))
+    graph_path = str(tmp_path / "graph.n5")
+    feat_path = str(tmp_path / "features.n5")
+
+    wf = GraphWorkflow(input_path=path, input_key="labels",
+                       graph_path=graph_path, tmp_folder=tmp_folder,
+                       config_dir=config_dir, max_jobs=2, target="threads")
+    fw = EdgeFeaturesWorkflow(
+        input_path=path, input_key="affs", labels_path=path,
+        labels_key="labels", graph_path=graph_path, output_path=feat_path,
+        offsets=offsets, tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads", dependency=wf)
+    assert ctt.build([fw])
+
+    _, edges, _ = load_graph(graph_path, "graph")
+    with file_reader(feat_path, "r") as f:
+        feats = f["features"][:]
+
+    # oracle: every anchor voxel samples its offset channel
+    samples = {}
+    for c, off in enumerate(offsets):
+        ax = [i for i, o in enumerate(off) if o][0]
+        a = np.moveaxis(labels, ax, 0)[1:]          # anchors i >= 1
+        b = np.moveaxis(labels, ax, 0)[:-1]         # neighbors i-1
+        va = np.moveaxis(affs[c], ax, 0)[1:]
+        m = a != b
+        for u, v, x in zip(a[m], b[m], va[m]):
+            samples.setdefault((min(u, v), max(u, v)), []).append(x)
+    edge_set = {tuple(e) for e in edges}
+    for (u, v), vals in samples.items():
+        if (u, v) not in edge_set:
+            continue
+        i = next(j for j, e in enumerate(edges) if tuple(e) == (u, v))
+        vals = np.asarray(vals, "float64")
+        assert feats[i, 9] == len(vals), (u, v)
+        np.testing.assert_allclose(feats[i, 0], vals.mean(), rtol=1e-6)
+    # every RAG edge gets direct-neighbor samples -> no zero-count rows
+    assert (feats[:, 9] > 0).all()
