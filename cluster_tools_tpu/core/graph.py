@@ -171,16 +171,19 @@ class Graph:
 
     def extract_subgraph(self, node_labels: np.ndarray
                          ) -> Tuple[np.ndarray, np.ndarray]:
-        """(inner_edge_mask, edge_ids): edges with BOTH endpoints in
-        ``node_labels`` (reference: graph.extractSubgraphFromNodes,
-        multicut/solve_subproblems.py:151)."""
+        """(inner_edge_ids, outer_edge_ids): edges with both / exactly one
+        endpoint in ``node_labels`` (reference:
+        graph.extractSubgraphFromNodes, multicut/solve_subproblems.py:151)."""
         node_labels = np.asarray(node_labels, dtype="uint64")
         if len(node_labels) == 0 or self.n_edges == 0:
-            return np.zeros(self.n_edges, bool), np.zeros(0, "int64")
+            return np.zeros(0, "int64"), np.zeros(0, "int64")
         lookup = np.sort(node_labels)
         iu = np.minimum(np.searchsorted(lookup, self.uv_ids[:, 0]),
                         len(lookup) - 1)
         iv = np.minimum(np.searchsorted(lookup, self.uv_ids[:, 1]),
                         len(lookup) - 1)
-        mask = (lookup[iu] == self.uv_ids[:, 0]) & (lookup[iv] == self.uv_ids[:, 1])
-        return mask, np.flatnonzero(mask).astype("int64")
+        in_u = lookup[iu] == self.uv_ids[:, 0]
+        in_v = lookup[iv] == self.uv_ids[:, 1]
+        inner = np.flatnonzero(in_u & in_v).astype("int64")
+        outer = np.flatnonzero(in_u ^ in_v).astype("int64")
+        return inner, outer

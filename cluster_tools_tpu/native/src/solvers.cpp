@@ -1,0 +1,335 @@
+// Native combinatorial kernels for the TPU framework.
+//
+// The reference delegates these to external pybind11 wheels (nifty's
+// Kernighan-Lin / greedy-additive multicut, boost union-find, affogato's
+// mutex watershed -- SURVEY.md section 2.3).  Combinatorial, data-dependent
+// algorithms do not map onto the MXU, so they live here as first-party C++
+// with a flat extern "C" array API loaded via ctypes (no pybind11 in the
+// image).  The device side produces the edge lists; these kernels consume
+// them on the host CPU.
+//
+// Build: g++ -O3 -march=native -shared -fPIC solvers.cpp -o libctt_native.so
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <queue>
+#include <tuple>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// union-find with path halving + union by size
+// ---------------------------------------------------------------------------
+struct Ufd {
+    std::vector<int64_t> parent;
+    std::vector<int64_t> size;
+    explicit Ufd(int64_t n) : parent(n), size(n, 1) {
+        std::iota(parent.begin(), parent.end(), 0);
+    }
+    int64_t find(int64_t x) {
+        while (parent[x] != x) {
+            parent[x] = parent[parent[x]];
+            x = parent[x];
+        }
+        return x;
+    }
+    // returns the surviving root, or -1 if already joined
+    int64_t merge(int64_t a, int64_t b) {
+        a = find(a);
+        b = find(b);
+        if (a == b) return -1;
+        if (size[a] < size[b]) std::swap(a, b);
+        parent[b] = a;
+        size[a] += size[b];
+        return a;
+    }
+};
+
+// multicut objective: sum of costs over cut edges (minimized)
+double objective(int64_t n_edges, const int64_t* uv, const double* costs,
+                 const uint64_t* labels) {
+    double e = 0.0;
+    for (int64_t i = 0; i < n_edges; ++i) {
+        if (labels[uv[2 * i]] != labels[uv[2 * i + 1]]) e += costs[i];
+    }
+    return e;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// union-find over pair lists (boost_ufd replacement,
+// reference: multicut/reduce_problem.py:161, thresholded_components)
+// ---------------------------------------------------------------------------
+// labels_out[i] = root of node i after merging all pairs.
+void ufd_merge_pairs(int64_t n_nodes, int64_t n_pairs, const int64_t* pairs,
+                     uint64_t* labels_out) {
+    Ufd ufd(n_nodes);
+    for (int64_t i = 0; i < n_pairs; ++i) {
+        ufd.merge(pairs[2 * i], pairs[2 * i + 1]);
+    }
+    for (int64_t i = 0; i < n_nodes; ++i) {
+        labels_out[i] = static_cast<uint64_t>(ufd.find(i));
+    }
+}
+
+// ---------------------------------------------------------------------------
+// greedy additive edge contraction (GAEC)
+// (nifty.graph.opt.multicut greedyAdditive replacement)
+// ---------------------------------------------------------------------------
+// Contract the most attractive (largest positive accumulated cost) edge until
+// none remains.  Dynamic graph as per-node hash maps, lazy priority queue.
+// labels_out: dense component labels in [0, n_components).
+int64_t mc_gaec(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
+                const double* costs, uint64_t* labels_out) {
+    std::vector<std::unordered_map<int64_t, double>> adj(n_nodes);
+    for (int64_t i = 0; i < n_edges; ++i) {
+        int64_t u = uv[2 * i], v = uv[2 * i + 1];
+        if (u == v) continue;
+        adj[u][v] += costs[i];
+        adj[v][u] += costs[i];
+    }
+    using Entry = std::tuple<double, int64_t, int64_t>;  // (w, u, v)
+    std::priority_queue<Entry> pq;
+    for (int64_t u = 0; u < n_nodes; ++u) {
+        for (const auto& kv : adj[u]) {
+            if (kv.first > u && kv.second > 0) pq.emplace(kv.second, u, kv.first);
+        }
+    }
+    Ufd ufd(n_nodes);
+    while (!pq.empty()) {
+        auto [w, u, v] = pq.top();
+        pq.pop();
+        if (w <= 0) break;
+        int64_t ru = ufd.find(u), rv = ufd.find(v);
+        // stale entry: nodes already merged or weight changed
+        if (ru == rv) continue;
+        auto it = adj[ru].find(rv);
+        if (it == adj[ru].end() || it->second != w || u != std::min(ru, rv) ||
+            v != std::max(ru, rv)) {
+            // re-push the current live pair if still attractive
+            if (it != adj[ru].end() && it->second > 0) {
+                pq.emplace(it->second, std::min(ru, rv), std::max(ru, rv));
+            }
+            continue;
+        }
+        // contract rv into ru (keep the larger adjacency)
+        if (adj[ru].size() < adj[rv].size()) std::swap(ru, rv);
+        int64_t rw = ufd.merge(ru, rv);
+        if (rw != ru) std::swap(ru, rv);  // ufd chose the other root
+        adj[ru].erase(rv);
+        adj[rv].erase(ru);
+        for (const auto& kv : adj[rv]) {
+            int64_t n = kv.first;
+            double nw = kv.second;
+            adj[n].erase(rv);
+            double& acc = adj[ru][n];
+            acc += nw;
+            adj[n][ru] = acc;
+            if (acc > 0) pq.emplace(acc, std::min(ru, n), std::max(ru, n));
+        }
+        adj[rv].clear();
+    }
+    // dense component labels
+    std::unordered_map<int64_t, uint64_t> remap;
+    uint64_t next = 0;
+    for (int64_t i = 0; i < n_nodes; ++i) {
+        int64_t r = ufd.find(i);
+        auto it = remap.find(r);
+        if (it == remap.end()) it = remap.emplace(r, next++).first;
+        labels_out[i] = it->second;
+    }
+    return static_cast<int64_t>(next);
+}
+
+// ---------------------------------------------------------------------------
+// Kernighan-Lin-style greedy node moves
+// (nifty multicutKernighanLin replacement: local search with joins)
+// ---------------------------------------------------------------------------
+// Improve labels_inout by repeatedly moving single nodes to the neighboring
+// component (or a fresh singleton) with the best objective gain, until a full
+// pass yields no improvement or max_passes is hit.  Returns passes used.
+int64_t mc_kl_refine(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
+                     const double* costs, uint64_t* labels, int64_t max_passes) {
+    // CSR adjacency
+    std::vector<int64_t> deg(n_nodes, 0);
+    for (int64_t i = 0; i < n_edges; ++i) {
+        ++deg[uv[2 * i]];
+        ++deg[uv[2 * i + 1]];
+    }
+    std::vector<int64_t> off(n_nodes + 1, 0);
+    for (int64_t i = 0; i < n_nodes; ++i) off[i + 1] = off[i] + deg[i];
+    std::vector<int64_t> nbr(off[n_nodes]);
+    std::vector<double> nw(off[n_nodes]);
+    std::vector<int64_t> cur(off.begin(), off.end() - 1);
+    for (int64_t i = 0; i < n_edges; ++i) {
+        int64_t u = uv[2 * i], v = uv[2 * i + 1];
+        nbr[cur[u]] = v;
+        nw[cur[u]++] = costs[i];
+        nbr[cur[v]] = u;
+        nw[cur[v]++] = costs[i];
+    }
+    uint64_t next_label = 0;
+    for (int64_t i = 0; i < n_nodes; ++i) next_label = std::max(next_label, labels[i] + 1);
+
+    std::unordered_map<uint64_t, double> comp_w;
+    int64_t pass = 0;
+    for (; pass < max_passes; ++pass) {
+        bool improved = false;
+        for (int64_t x = 0; x < n_nodes; ++x) {
+            if (off[x + 1] == off[x]) continue;
+            comp_w.clear();
+            for (int64_t j = off[x]; j < off[x + 1]; ++j) {
+                comp_w[labels[nbr[j]]] += nw[j];
+            }
+            uint64_t own = labels[x];
+            double w_own = 0.0;
+            auto it_own = comp_w.find(own);
+            if (it_own != comp_w.end()) w_own = it_own->second;
+            // candidate: fresh singleton (gain = w_own if w_own < 0)
+            double best_gain = -w_own;  // delta objective of leaving to empty
+            uint64_t best_label = next_label;
+            for (const auto& kv : comp_w) {
+                if (kv.first == own) continue;
+                double gain = kv.second - w_own;  // uncut B, cut own
+                if (gain > best_gain + 1e-12) {
+                    best_gain = gain;
+                    best_label = kv.first;
+                }
+            }
+            if (best_gain > 1e-12) {
+                labels[x] = best_label;
+                if (best_label == next_label) ++next_label;
+                improved = true;
+            }
+        }
+        if (!improved) break;
+    }
+    return pass;
+}
+
+double mc_objective(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
+                    const double* costs, const uint64_t* labels) {
+    (void)n_nodes;
+    return objective(n_edges, uv, costs, labels);
+}
+
+// ---------------------------------------------------------------------------
+// mutex watershed (affogato compute_mws_clustering replacement)
+// ---------------------------------------------------------------------------
+// Kruskal-style: process attractive and mutex (repulsive) edges jointly in
+// descending weight order; attractive edges union unless a mutex constraint
+// exists between the roots; mutex edges install constraints.
+int64_t mws_clustering(int64_t n_nodes, int64_t n_attr, const int64_t* uv_attr,
+                       const double* w_attr, int64_t n_mutex,
+                       const int64_t* uv_mutex, const double* w_mutex,
+                       uint64_t* labels_out) {
+    struct E {
+        double w;
+        int64_t u, v;
+        bool mutex;
+    };
+    std::vector<E> edges;
+    edges.reserve(n_attr + n_mutex);
+    for (int64_t i = 0; i < n_attr; ++i) {
+        edges.push_back({w_attr[i], uv_attr[2 * i], uv_attr[2 * i + 1], false});
+    }
+    for (int64_t i = 0; i < n_mutex; ++i) {
+        edges.push_back({w_mutex[i], uv_mutex[2 * i], uv_mutex[2 * i + 1], true});
+    }
+    std::stable_sort(edges.begin(), edges.end(),
+                     [](const E& a, const E& b) { return a.w > b.w; });
+
+    Ufd ufd(n_nodes);
+    // mutex constraints per root (merged small-into-large on union)
+    std::vector<std::unordered_set<int64_t>> mtx(n_nodes);
+    auto have_mutex = [&](int64_t ra, int64_t rb) {
+        const auto& small = mtx[ra].size() < mtx[rb].size() ? mtx[ra] : mtx[rb];
+        int64_t other = (&small == &mtx[ra]) ? rb : ra;
+        return small.count(other) > 0;
+    };
+    for (const auto& e : edges) {
+        int64_t ru = ufd.find(e.u), rv = ufd.find(e.v);
+        if (ru == rv) continue;
+        if (e.mutex) {
+            mtx[ru].insert(rv);
+            mtx[rv].insert(ru);
+        } else {
+            if (have_mutex(ru, rv)) continue;
+            int64_t keep = ufd.merge(ru, rv);
+            int64_t gone = keep == ru ? rv : ru;
+            // rewire constraints of the vanished root
+            if (mtx[gone].size() > mtx[keep].size()) std::swap(mtx[gone], mtx[keep]);
+            for (int64_t c : mtx[gone]) {
+                mtx[c].erase(gone);
+                if (c != keep) {
+                    mtx[c].insert(keep);
+                    mtx[keep].insert(c);
+                }
+            }
+            mtx[gone].clear();
+        }
+    }
+    std::unordered_map<int64_t, uint64_t> remap;
+    uint64_t next = 0;
+    for (int64_t i = 0; i < n_nodes; ++i) {
+        int64_t r = ufd.find(i);
+        auto it = remap.find(r);
+        if (it == remap.end()) it = remap.emplace(r, next++).first;
+        labels_out[i] = it->second;
+    }
+    return static_cast<int64_t>(next);
+}
+
+// edge-weighted seeded watershed on a graph
+// (nifty.graph.edgeWeightedWatershedsSegmentation replacement,
+// reference: postprocess/graph_watershed_assignments.py:172)
+// Grows seed labels along maximum-weight edges (Prim-style).
+void graph_watershed(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
+                     const double* weights, uint64_t* seeds_inout) {
+    std::vector<int64_t> deg(n_nodes, 0);
+    for (int64_t i = 0; i < n_edges; ++i) {
+        ++deg[uv[2 * i]];
+        ++deg[uv[2 * i + 1]];
+    }
+    std::vector<int64_t> off(n_nodes + 1, 0);
+    for (int64_t i = 0; i < n_nodes; ++i) off[i + 1] = off[i] + deg[i];
+    std::vector<int64_t> nbr(off[n_nodes]);
+    std::vector<double> nw(off[n_nodes]);
+    {
+        std::vector<int64_t> cur(off.begin(), off.end() - 1);
+        for (int64_t i = 0; i < n_edges; ++i) {
+            int64_t u = uv[2 * i], v = uv[2 * i + 1];
+            nbr[cur[u]] = v;
+            nw[cur[u]++] = weights[i];
+            nbr[cur[v]] = u;
+            nw[cur[v]++] = weights[i];
+        }
+    }
+    using Entry = std::tuple<double, int64_t, int64_t>;  // (w, from, to)
+    std::priority_queue<Entry> pq;
+    for (int64_t i = 0; i < n_nodes; ++i) {
+        if (seeds_inout[i] == 0) continue;
+        for (int64_t j = off[i]; j < off[i + 1]; ++j) {
+            if (seeds_inout[nbr[j]] == 0) pq.emplace(nw[j], i, nbr[j]);
+        }
+    }
+    while (!pq.empty()) {
+        auto [w, from, to] = pq.top();
+        pq.pop();
+        if (seeds_inout[to] != 0) continue;
+        seeds_inout[to] = seeds_inout[from];
+        for (int64_t j = off[to]; j < off[to + 1]; ++j) {
+            if (seeds_inout[nbr[j]] == 0) pq.emplace(nw[j], to, nbr[j]);
+        }
+    }
+}
+
+}  // extern "C"

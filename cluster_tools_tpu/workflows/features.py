@@ -44,12 +44,14 @@ class BlockEdgeFeatures(BlockTask):
 
     def __init__(self, input_path: str, input_key: str, labels_path: str,
                  labels_key: str, graph_path: str, output_path: str,
-                 offsets: Optional[List[List[int]]] = None, **kw):
+                 offsets: Optional[List[List[int]]] = None,
+                 graph_key: str = "graph", **kw):
         self.input_path = input_path
         self.input_key = input_key
         self.labels_path = labels_path
         self.labels_key = labels_key
         self.graph_path = graph_path
+        self.graph_key = graph_key
         self.output_path = output_path
         self.offsets = offsets
         super().__init__(**kw)
@@ -64,8 +66,8 @@ class BlockEdgeFeatures(BlockTask):
         self.run_jobs(block_list, {
             "input_path": self.input_path, "input_key": self.input_key,
             "labels_path": self.labels_path, "labels_key": self.labels_key,
-            "graph_path": self.graph_path, "output_path": self.output_path,
-            "offsets": self.offsets,
+            "graph_path": self.graph_path, "graph_key": self.graph_key,
+            "output_path": self.output_path, "offsets": self.offsets,
             "shape": shape, "block_shape": block_shape,
         }, n_jobs=self.max_jobs)
 
@@ -216,6 +218,7 @@ class EdgeFeaturesWorkflow(Task):
                  tmp_folder: str, config_dir: str, max_jobs: int = 1,
                  target: str = "local", output_key: str = "features",
                  offsets: Optional[List[List[int]]] = None,
+                 graph_key: str = "graph",
                  dependency: Optional[Task] = None):
         self.kw = dict(tmp_folder=tmp_folder, config_dir=config_dir,
                        max_jobs=max_jobs, target=target)
@@ -224,18 +227,21 @@ class EdgeFeaturesWorkflow(Task):
                          graph_path=graph_path, output_path=output_path)
         self.output_key = output_key
         self.offsets = offsets
+        self.graph_key = graph_key
         self.tmp_folder = tmp_folder
         self.dependency = dependency
         super().__init__()
 
     def requires(self):
         t1 = BlockEdgeFeatures(offsets=self.offsets,
+                               graph_key=self.graph_key,
                                dependency=self.dependency,
                                **self.args, **self.kw)
         return MergeEdgeFeatures(
             graph_path=self.args["graph_path"],
             output_path=self.args["output_path"],
-            output_key=self.output_key, dependency=t1, **self.kw)
+            output_key=self.output_key, graph_key=self.graph_key,
+            dependency=t1, **self.kw)
 
     def output(self):
         from ..core.workflow import FileTarget

@@ -1,0 +1,345 @@
+"""Hierarchical blockwise multicut — the flagship workflow.
+
+Re-specification of the reference's ``multicut/`` package (SURVEY §3.3, the
+ICCV'17 domain-decomposition ladder): solve per-block subproblems -> mark cut
+edges -> reduce the graph by merging uncut edges -> recurse with doubled
+blocks -> solve the reduced problem globally.  The combinatorial solvers are
+first-party C++ (cluster_tools_tpu.native: GAEC + KL-style local search,
+union-find); everything else is vectorized host numpy over the flat graph
+arrays produced by the device RAG stack.
+
+Problem-container layout (mirrors the reference's problem_path, SURVEY §5.4):
+
+    s0/graph            from GraphWorkflow (edges, nodes, attrs)
+    s0/costs            from EdgeCostsWorkflow
+    s<i>/sub_graphs/block_<b>.npz        per-block node sets
+    s<i>/sub_results/block_<b>.npz       per-block cut edge ids
+    s<i+1>/graph, s<i+1>/costs           reduced problem
+    s<i+1>/node_labeling                 dense s0-node -> current-node map
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import graph as g
+from ..core.blocking import Blocking
+from ..core.runtime import BlockTask
+from ..core.solvers import key_to_agglomerator
+from ..core.storage import file_reader
+from ..core.workflow import Task
+
+
+def _load_costs(problem_path: str, scale: int) -> np.ndarray:
+    with file_reader(problem_path, "r") as f:
+        return f[f"s{scale}/costs"][:]
+
+
+def _load_scale_graph(problem_path: str, scale: int):
+    """(uv_dense, n_nodes, s0_nodes).  At scale 0, uv ids are original labels
+    mapped to dense indices via the sorted node table; at scale > 0 the
+    reduced graph is already dense."""
+    nodes, edges, attrs = g.load_graph(problem_path, f"s{scale}/graph")
+    if scale == 0:
+        graph = g.Graph(nodes, edges)
+        uv_dense = np.stack([graph.node_index(edges[:, 0]),
+                             graph.node_index(edges[:, 1])], axis=1)
+        return uv_dense, len(nodes), nodes
+    n_nodes = int(attrs["n_nodes"])
+    return edges.astype("int64"), n_nodes, None
+
+
+def _sub_result_path(problem_path: str, scale: int, block_id: int) -> str:
+    return os.path.join(problem_path, f"s{scale}", "sub_results",
+                        f"block_{block_id}.npz")
+
+
+class SolveSubproblems(BlockTask):
+    """Per-block multicut over the scale's merged blocks (reference:
+    SolveSubproblems, solve_subproblems.py:128-213)."""
+
+    task_name = "solve_subproblems"
+
+    def __init__(self, problem_path: str, scale: int, **kw):
+        self.problem_path = problem_path
+        self.scale = scale
+        self.identifier = f"s{scale}"
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"agglomerator": "kernighan-lin", "time_limit_solver": None})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.problem_path, "r") as f:
+            shape = list(f[f"s0/graph"].attrs["shape"])
+        base_bs = self.global_block_shape()
+        scale_bs = [b * 2 ** self.scale for b in base_bs]
+        block_list = self.blocks_in_volume(shape, scale_bs)
+        self.run_jobs(block_list, {
+            "problem_path": self.problem_path, "scale": self.scale,
+            "shape": shape, "block_shape": base_bs,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        problem_path = cfg["problem_path"]
+        scale = int(cfg["scale"])
+        agglomerator = key_to_agglomerator(
+            cfg.get("agglomerator", "kernighan-lin"))
+
+        uv_dense, n_nodes, s0_nodes = _load_scale_graph(problem_path, scale)
+        costs = _load_costs(problem_path, scale)
+        graph = g.Graph(np.arange(n_nodes, dtype="uint64"),
+                        uv_dense.astype("uint64"))
+        os.makedirs(os.path.join(problem_path, f"s{scale}", "sub_results"),
+                    exist_ok=True)
+
+        for block_id in job_config["block_list"]:
+            data = g.load_sub_graph(problem_path, scale, block_id)
+            nodes = data["nodes"]
+            if scale == 0:
+                # map original labels to dense ids; every block node is in
+                # the global node table by construction (0 already stripped)
+                nodes_dense = np.searchsorted(s0_nodes, nodes)
+            else:
+                nodes_dense = nodes.astype("int64")
+            inner, outer = graph.extract_subgraph(nodes_dense.astype("uint64"))
+            if len(inner) == 0:
+                cut_ids = outer
+            else:
+                sub_uv = uv_dense[inner]
+                sub_nodes, local_uv_flat = np.unique(sub_uv, return_inverse=True)
+                local_uv = local_uv_flat.reshape(-1, 2).astype("int64")
+                sub_costs = costs[inner]
+                sub_res = agglomerator(len(sub_nodes), local_uv, sub_costs)
+                cut_mask = sub_res[local_uv[:, 0]] != sub_res[local_uv[:, 1]]
+                cut_ids = np.concatenate([inner[cut_mask], outer])
+            path = _sub_result_path(problem_path, scale, block_id)
+            tmp = path + ".tmp.npz"
+            np.savez(tmp, cut_edge_ids=cut_ids.astype("int64"))
+            os.replace(tmp, path)
+            log_fn(f"processed block {block_id}")
+
+
+class ReduceProblem(BlockTask):
+    """Global job: merge uncut edges, relabel, build the reduced problem for
+    the next scale (reference: ReduceProblem, reduce_problem.py:26-286)."""
+
+    task_name = "reduce_problem"
+    global_task = True
+    allow_retry = False
+
+    def __init__(self, problem_path: str, scale: int, **kw):
+        self.problem_path = problem_path
+        self.scale = scale
+        self.identifier = f"s{scale}"
+        super().__init__(**kw)
+
+    def run_impl(self):
+        with file_reader(self.problem_path, "r") as f:
+            shape = list(f["s0/graph"].attrs["shape"])
+        self.run_jobs(None, {
+            "problem_path": self.problem_path, "scale": self.scale,
+            "shape": shape, "block_shape": self.global_block_shape(),
+        })
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from .. import native
+
+        cfg = job_config["config"]
+        problem_path = cfg["problem_path"]
+        scale = int(cfg["scale"])
+        shape = cfg["shape"]
+        base_bs = cfg["block_shape"]
+
+        uv_dense, n_nodes, s0_nodes = _load_scale_graph(problem_path, scale)
+        costs = _load_costs(problem_path, scale)
+
+        # gather cut edges from all blocks at this scale
+        scale_bs = [b * 2 ** scale for b in base_bs]
+        blocking = Blocking(shape, scale_bs)
+        cut_lists = []
+        for bid in range(blocking.n_blocks):
+            path = _sub_result_path(problem_path, scale, bid)
+            if os.path.exists(path):
+                with np.load(path) as d:
+                    cut_lists.append(d["cut_edge_ids"])
+        cut_ids = (np.unique(np.concatenate(cut_lists)) if cut_lists
+                   else np.zeros(0, "int64"))
+        merge_mask = np.ones(len(uv_dense), bool)
+        merge_mask[cut_ids] = False
+        log_fn(f"merging {int(merge_mask.sum())} / {len(uv_dense)} edges")
+
+        # union-find merge of uncut edges -> consecutive node labeling
+        roots = native.ufd_merge_pairs(n_nodes, uv_dense[merge_mask])
+        _, node_labeling = np.unique(roots, return_inverse=True)
+        node_labeling = node_labeling.astype("uint64")
+        n_new_nodes = int(node_labeling.max()) + 1 if n_nodes else 0
+        log_fn(f"reduced {n_nodes} -> {n_new_nodes} nodes")
+
+        # compose with the initial (s0 -> current) labeling
+        if scale == 0:
+            new_initial = node_labeling
+        else:
+            with file_reader(problem_path, "r") as f:
+                initial = f[f"s{scale}/node_labeling"][:]
+            new_initial = node_labeling[initial.astype("int64")]
+
+        # edge mapping: relabeled uv, dropped self-edges, summed costs
+        mapped = node_labeling[uv_dense]
+        keep = mapped[:, 0] != mapped[:, 1]
+        mu = np.minimum(mapped[keep][:, 0], mapped[keep][:, 1])
+        mv = np.maximum(mapped[keep][:, 0], mapped[keep][:, 1])
+        pair = np.stack([mu, mv], axis=1)
+        new_uv, inverse = np.unique(pair, axis=0, return_inverse=True)
+        new_costs = np.zeros(len(new_uv), "float64")
+        np.add.at(new_costs, inverse, costs[keep])
+
+        # next-scale sub-graphs: merged-block node sets mapped through the
+        # labeling (reference: ndist.serializeMergedGraph)
+        next_scale = scale + 1
+        new_bs = [b * 2 ** next_scale for b in base_bs]
+        new_blocking = Blocking(shape, new_bs)
+        for new_bid in range(new_blocking.n_blocks):
+            block = new_blocking.get_block(new_bid)
+            child_ids = blocking.blocks_in_roi(block.begin, block.end)
+            node_sets = []
+            for cid in child_ids:
+                data = g.load_sub_graph(problem_path, scale, cid)
+                nodes = data["nodes"]
+                if scale == 0:
+                    nodes = np.searchsorted(s0_nodes, nodes)
+                node_sets.append(node_labeling[nodes.astype("int64")])
+            merged_nodes = (np.unique(np.concatenate(node_sets))
+                            if node_sets else np.zeros(0, "uint64"))
+            g.save_sub_graph(problem_path, next_scale, new_bid, merged_nodes,
+                             np.zeros((0, 2), "uint64"))
+
+        # serialize reduced problem
+        g.save_graph(problem_path, f"s{next_scale}/graph",
+                     np.arange(n_new_nodes, dtype="uint64"),
+                     new_uv.astype("uint64"), shape)
+        with file_reader(problem_path) as f:
+            ds = f.require_dataset(f"s{next_scale}/costs",
+                                   shape=(len(new_costs),),
+                                   chunks=(max(len(new_costs), 1),),
+                                   dtype="float64")
+            ds[:] = new_costs
+            ds2 = f.require_dataset(f"s{next_scale}/node_labeling",
+                                    shape=(len(new_initial),),
+                                    chunks=(max(len(new_initial), 1),),
+                                    dtype="uint64")
+            ds2[:] = new_initial
+        log_fn(f"reduced problem: {len(new_uv)} edges at scale {next_scale}")
+
+
+class SolveGlobal(BlockTask):
+    """Single global solve of the reduced problem; writes the final
+    fragment -> segment assignment table (reference: SolveGlobal,
+    solve_global.py:99+)."""
+
+    task_name = "solve_global"
+    global_task = True
+    allow_retry = False
+
+    def __init__(self, problem_path: str, scale: int, assignment_path: str,
+                 assignment_key: str = "node_labels", **kw):
+        self.problem_path = problem_path
+        self.scale = scale
+        self.assignment_path = assignment_path
+        self.assignment_key = assignment_key
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"agglomerator": "kernighan-lin", "time_limit_solver": None})
+        return conf
+
+    def run_impl(self):
+        self.run_jobs(None, {
+            "problem_path": self.problem_path, "scale": self.scale,
+            "assignment_path": self.assignment_path,
+            "assignment_key": self.assignment_key,
+        })
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        problem_path = cfg["problem_path"]
+        scale = int(cfg["scale"])
+        agglomerator = key_to_agglomerator(
+            cfg.get("agglomerator", "kernighan-lin"))
+
+        uv_dense, n_nodes, s0_nodes = _load_scale_graph(problem_path, scale)
+        costs = _load_costs(problem_path, scale)
+        labels = agglomerator(n_nodes, uv_dense.astype("int64"), costs)
+        log_fn(f"global solve: {n_nodes} nodes -> "
+               f"{len(np.unique(labels))} segments")
+
+        # compose back to s0 fragments
+        if scale == 0:
+            final = labels
+        else:
+            with file_reader(problem_path, "r") as f:
+                initial = f[f"s{scale}/node_labeling"][:]
+            final = labels[initial.astype("int64")]
+        nodes0, _, _ = g.load_graph(problem_path, "s0/graph")
+
+        # inflate to a dense assignment table over [0, max_label]; 0 and gaps
+        # stay background; segment ids start at 1
+        _, consecutive = np.unique(final, return_inverse=True)
+        max_label = int(nodes0.max()) if len(nodes0) else 0
+        table = np.zeros(max_label + 1, dtype="uint64")
+        table[nodes0.astype("int64")] = consecutive.astype("uint64") + 1
+        np.save(cfg["assignment_path"], table)
+        log_fn(f"assignments saved: {len(table)} fragment ids")
+
+
+class MulticutWorkflow(Task):
+    """for scale in 0..n_scales-1: SolveSubproblems -> ReduceProblem; then
+    SolveGlobal (reference: multicut_workflow.py:49-61)."""
+
+    def __init__(self, problem_path: str, assignment_path: str,
+                 tmp_folder: str, config_dir: str, max_jobs: int = 1,
+                 target: str = "local", n_scales: int = 1,
+                 dependency: Optional[Task] = None):
+        self.problem_path = problem_path
+        self.assignment_path = assignment_path
+        self.n_scales = n_scales
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def _common(self):
+        return dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                    max_jobs=self.max_jobs, target=self.target)
+
+    def requires(self):
+        dep = self.dependency
+        for scale in range(self.n_scales):
+            dep = SolveSubproblems(problem_path=self.problem_path,
+                                   scale=scale, dependency=dep,
+                                   **self._common())
+            dep = ReduceProblem(problem_path=self.problem_path, scale=scale,
+                                dependency=dep, **self._common())
+        return SolveGlobal(problem_path=self.problem_path,
+                           scale=self.n_scales,
+                           assignment_path=self.assignment_path,
+                           dependency=dep, **self._common())
+
+    def output(self):
+        from ..core.workflow import FileTarget
+
+        return FileTarget(os.path.join(self.tmp_folder, "solve_global.status"))

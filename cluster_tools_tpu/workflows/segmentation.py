@@ -1,0 +1,127 @@
+"""Top-level segmentation pipelines (reference: cluster_tools/workflows.py).
+
+``ProblemWorkflow`` assembles the multicut problem container (graph +
+features + costs, reference workflows.py:29-108); the segmentation workflows
+chain it with the solver ladder and the final write
+(MulticutSegmentationWorkflow, reference workflows.py:204-233).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..core.storage import file_reader
+from ..core.workflow import FileTarget, Task
+from .costs import EdgeCostsWorkflow
+from .features import EdgeFeaturesWorkflow
+from .graph import GraphWorkflow
+from .multicut import MulticutWorkflow
+from .write import WriteAssignments
+
+
+class ProblemWorkflow(Task):
+    """graph -> edge features -> costs into one problem container
+    (reference: ProblemWorkflow, workflows.py:29-108)."""
+
+    def __init__(self, input_path: str, input_key: str, ws_path: str,
+                 ws_key: str, problem_path: str, tmp_folder: str,
+                 config_dir: str, max_jobs: int = 1, target: str = "local",
+                 n_scales_graph: int = 1,
+                 offsets: Optional[List[List[int]]] = None,
+                 dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.problem_path = problem_path
+        self.n_scales_graph = n_scales_graph
+        self.offsets = offsets
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def _common(self):
+        return dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                    max_jobs=self.max_jobs, target=self.target)
+
+    def requires(self):
+        graph_wf = GraphWorkflow(
+            input_path=self.ws_path, input_key=self.ws_key,
+            graph_path=self.problem_path, output_key="s0/graph",
+            n_scales=self.n_scales_graph, dependency=self.dependency,
+            **self._common())
+        feat_wf = EdgeFeaturesWorkflow(
+            input_path=self.input_path, input_key=self.input_key,
+            labels_path=self.ws_path, labels_key=self.ws_key,
+            graph_path=self.problem_path, output_path=self.problem_path,
+            output_key="features", offsets=self.offsets, dependency=graph_wf,
+            **self._common())
+        return EdgeCostsWorkflow(
+            features_path=self.problem_path, features_key="features",
+            output_path=self.problem_path, output_key="s0/costs",
+            graph_path=self.problem_path, dependency=feat_wf,
+            **self._common())
+
+    def output(self):
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "probs_to_costs.status"))
+
+
+class MulticutSegmentationWorkflow(Task):
+    """Problem -> hierarchical multicut -> write segmentation
+    (reference: MulticutSegmentationWorkflow, workflows.py:204-233).
+
+    ``ws_path/ws_key`` are the watershed fragments (chain WatershedWorkflow
+    upstream via ``dependency`` to produce them)."""
+
+    def __init__(self, input_path: str, input_key: str, ws_path: str,
+                 ws_key: str, problem_path: str, output_path: str,
+                 output_key: str, tmp_folder: str, config_dir: str,
+                 max_jobs: int = 1, target: str = "local",
+                 n_scales: int = 1,
+                 offsets: Optional[List[List[int]]] = None,
+                 dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.problem_path = problem_path
+        self.output_path = output_path
+        self.output_key = output_key
+        self.n_scales = n_scales
+        self.offsets = offsets
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def _common(self):
+        return dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                    max_jobs=self.max_jobs, target=self.target)
+
+    def requires(self):
+        assignment_path = os.path.join(self.tmp_folder,
+                                       "multicut_assignments.npy")
+        problem = ProblemWorkflow(
+            input_path=self.input_path, input_key=self.input_key,
+            ws_path=self.ws_path, ws_key=self.ws_key,
+            problem_path=self.problem_path, offsets=self.offsets,
+            dependency=self.dependency, **self._common())
+        multicut = MulticutWorkflow(
+            problem_path=self.problem_path, assignment_path=assignment_path,
+            n_scales=self.n_scales, dependency=problem, **self._common())
+        return WriteAssignments(
+            input_path=self.ws_path, input_key=self.ws_key,
+            output_path=self.output_path, output_key=self.output_key,
+            assignment_path=assignment_path, identifier="multicut",
+            dependency=multicut, **self._common())
+
+    def output(self):
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "write_multicut.status"))

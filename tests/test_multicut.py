@@ -1,0 +1,138 @@
+"""Multicut solvers (vs brute force) and the hierarchical workflow
+(vs ground-truth recovery on a synthetic oversegmentation)."""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+
+def _brute_force_multicut(n_nodes, uv, costs):
+    """Exact minimum over all partitions (Bell-number enumeration, n <= 8).
+
+    Only connected partitions matter for multicut, and any labeling's
+    objective >= the best connected one, so plain label enumeration is a
+    valid oracle for the optimal objective value.
+    """
+    best = np.inf
+    best_lab = None
+    for labels in itertools.product(range(n_nodes), repeat=n_nodes):
+        lab = np.array(labels)
+        cut = lab[uv[:, 0]] != lab[uv[:, 1]]
+        obj = costs[cut].sum()
+        if obj < best:
+            best = obj
+            best_lab = lab
+    return best, best_lab
+
+
+def test_solvers_reach_bruteforce_optimum():
+    from cluster_tools_tpu import native
+    from cluster_tools_tpu.core.solvers import (
+        multicut_decomposition, multicut_gaec, multicut_kernighan_lin)
+
+    rng = np.random.RandomState(0)
+    for trial in range(5):
+        n = 6
+        edges = np.array([(i, j) for i in range(n) for j in range(i + 1, n)
+                          if rng.rand() < 0.7], dtype="int64")
+        costs = rng.randn(len(edges)).astype("float64")
+        opt, _ = _brute_force_multicut(n, edges, costs)
+        kl = multicut_kernighan_lin(n, edges, costs)
+        obj_kl = native.multicut_objective(edges, costs, kl)
+        # KL with GAEC warmstart must reach the optimum on tiny instances
+        assert obj_kl <= opt + 1e-9, (trial, obj_kl, opt)
+        obj_gaec = native.multicut_objective(
+            edges, costs, multicut_gaec(n, edges, costs))
+        assert obj_gaec <= opt + abs(opt)  # gaec alone: sane, near-opt
+        obj_dec = native.multicut_objective(
+            edges, costs, multicut_decomposition(n, edges, costs))
+        assert obj_dec <= opt + abs(opt) + 1e-9
+
+
+def test_ufd_and_mws():
+    from cluster_tools_tpu import native
+
+    roots = native.ufd_merge_pairs(
+        6, np.array([[0, 1], [1, 2], [4, 5]], "int64"))
+    assert roots[0] == roots[1] == roots[2]
+    assert roots[4] == roots[5] != roots[3]
+
+    # mutex blocks transitive merge through weaker attractive edge
+    lab = native.mutex_clustering(
+        3, np.array([[0, 1], [1, 2]], "int64"), np.array([0.9, 0.4]),
+        np.array([[0, 2]], "int64"), np.array([0.8]))
+    assert lab[0] == lab[1] and lab[0] != lab[2]
+
+
+def test_graph_watershed_grows_across_low_boundaries():
+    from cluster_tools_tpu import native
+
+    # chain 0-1-2-3, seeds at ends; boundary evidence low on the left
+    uv = np.array([[0, 1], [1, 2], [2, 3]], "int64")
+    w = np.array([0.1, 0.2, 0.9])
+    out = native.graph_watershed(4, uv, w, np.array([5, 0, 0, 9], "uint64"))
+    np.testing.assert_array_equal(out, [5, 5, 5, 9])
+
+
+def _nested_voronoi(shape=(24, 24, 24), n_true=4, n_frag=40, seed=3):
+    """(true_labels, fragments): fragments strictly nest inside true cells."""
+    rng = np.random.RandomState(seed)
+    grids = np.meshgrid(*[np.arange(s) for s in shape], indexing="ij")
+    coords = np.stack(grids, -1).astype("float32")
+
+    pts_t = rng.rand(n_true, 3) * np.array(shape)
+    d_t = np.stack([np.linalg.norm(coords - p, axis=-1) for p in pts_t])
+    true = np.argmin(d_t, axis=0) + 1
+
+    pts_f = rng.rand(n_frag, 3) * np.array(shape)
+    d_f = np.stack([np.linalg.norm(coords - p, axis=-1) for p in pts_f])
+    frag_raw = np.argmin(d_f, axis=0)
+    composite = true * (n_frag + 1) + frag_raw
+    _, frags = np.unique(composite, return_inverse=True)
+    return true.astype("uint64"), (frags + 1).reshape(shape).astype("uint64")
+
+
+@pytest.mark.parametrize("n_scales", [1, 2])
+def test_multicut_segmentation_recovers_truth(tmp_path, tmp_workdir, n_scales):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.workflows.segmentation import (
+        MulticutSegmentationWorkflow)
+
+    tmp_folder, config_dir = tmp_workdir
+    true, frags = _nested_voronoi()
+    # boundary map: 1 on true-cell boundaries (one-voxel dilation), 0 inside
+    bnd = np.zeros(true.shape, "float32")
+    for ax in range(3):
+        hi = np.moveaxis(true, ax, 0)
+        diff = hi[:-1] != hi[1:]
+        b = np.moveaxis(bnd, ax, 0)
+        b[:-1][diff] = 1.0
+        b[1:][diff] = 1.0
+
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.require_dataset("bmap", shape=bnd.shape, chunks=(12, 12, 12),
+                          dtype="float32")[:] = bnd
+        f.require_dataset("ws", shape=frags.shape, chunks=(12, 12, 12),
+                          dtype="uint64")[:] = frags
+
+    wf = MulticutSegmentationWorkflow(
+        input_path=path, input_key="bmap", ws_path=path, ws_key="ws",
+        problem_path=str(tmp_path / "problem.n5"), output_path=path,
+        output_key="seg", tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads", n_scales=n_scales)
+    assert ctt.build([wf])
+
+    with file_reader(path, "r") as f:
+        seg = f["seg"][:]
+    # segmentation must reproduce the true cells exactly (modulo label names):
+    # every true cell maps to exactly one segment id and vice versa
+    from itertools import product
+    pairs = np.unique(np.stack([true.ravel(), seg.ravel()], 1), axis=0)
+    t_ids, s_ids = np.unique(pairs[:, 0]), np.unique(pairs[:, 1])
+    assert len(pairs) == len(t_ids) == len(s_ids), (
+        f"not a bijection: {len(pairs)} pairs, {len(t_ids)} true, "
+        f"{len(s_ids)} seg")
