@@ -158,13 +158,14 @@ class EdgeCostsWorkflow(Task):
                  output_path: str, output_key: str, graph_path: str,
                  tmp_folder: str, config_dir: str, max_jobs: int = 1,
                  target: str = "local", node_labels_path: str = "",
-                 node_labels_key: str = "",
+                 node_labels_key: str = "", graph_key: str = "graph",
                  dependency: Optional[Task] = None):
         self.features_path = features_path
         self.features_key = features_key
         self.output_path = output_path
         self.output_key = output_key
         self.graph_path = graph_path
+        self.graph_key = graph_key
         self.node_labels_path = node_labels_path
         self.node_labels_key = node_labels_key
         self.tmp_folder = tmp_folder
@@ -178,7 +179,7 @@ class EdgeCostsWorkflow(Task):
         return ProbsToCosts(
             input_path=self.features_path, input_key=self.features_key,
             output_path=self.output_path, output_key=self.output_key,
-            graph_path=self.graph_path,
+            graph_path=self.graph_path, graph_key=self.graph_key,
             node_labels_path=self.node_labels_path,
             node_labels_key=self.node_labels_key,
             tmp_folder=self.tmp_folder, config_dir=self.config_dir,

@@ -57,14 +57,15 @@ class ProblemWorkflow(Task):
         feat_wf = EdgeFeaturesWorkflow(
             input_path=self.input_path, input_key=self.input_key,
             labels_path=self.ws_path, labels_key=self.ws_key,
-            graph_path=self.problem_path, output_path=self.problem_path,
+            graph_path=self.problem_path, graph_key="s0/graph",
+            output_path=self.problem_path,
             output_key="features", offsets=self.offsets, dependency=graph_wf,
             **self._common())
         return EdgeCostsWorkflow(
             features_path=self.problem_path, features_key="features",
             output_path=self.problem_path, output_key="s0/costs",
-            graph_path=self.problem_path, dependency=feat_wf,
-            **self._common())
+            graph_path=self.problem_path, graph_key="s0/graph",
+            dependency=feat_wf, **self._common())
 
     def output(self):
         return FileTarget(os.path.join(self.tmp_folder,

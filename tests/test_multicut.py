@@ -94,6 +94,18 @@ def _nested_voronoi(shape=(24, 24, 24), n_true=4, n_frag=40, seed=3):
     return true.astype("uint64"), (frags + 1).reshape(shape).astype("uint64")
 
 
+def _boundary_map(true):
+    """1 on true-cell boundaries (one-voxel dilation to both sides), 0 inside."""
+    bnd = np.zeros(true.shape, "float32")
+    for ax in range(3):
+        hi = np.moveaxis(true, ax, 0)
+        diff = hi[:-1] != hi[1:]
+        b = np.moveaxis(bnd, ax, 0)
+        b[:-1][diff] = 1.0
+        b[1:][diff] = 1.0
+    return bnd
+
+
 @pytest.mark.parametrize("n_scales", [1, 2])
 def test_multicut_segmentation_recovers_truth(tmp_path, tmp_workdir, n_scales):
     import cluster_tools_tpu as ctt
@@ -103,14 +115,7 @@ def test_multicut_segmentation_recovers_truth(tmp_path, tmp_workdir, n_scales):
 
     tmp_folder, config_dir = tmp_workdir
     true, frags = _nested_voronoi()
-    # boundary map: 1 on true-cell boundaries (one-voxel dilation), 0 inside
-    bnd = np.zeros(true.shape, "float32")
-    for ax in range(3):
-        hi = np.moveaxis(true, ax, 0)
-        diff = hi[:-1] != hi[1:]
-        b = np.moveaxis(bnd, ax, 0)
-        b[:-1][diff] = 1.0
-        b[1:][diff] = 1.0
+    bnd = _boundary_map(true)
 
     path = str(tmp_path / "data.n5")
     with file_reader(path) as f:
@@ -128,11 +133,104 @@ def test_multicut_segmentation_recovers_truth(tmp_path, tmp_workdir, n_scales):
 
     with file_reader(path, "r") as f:
         seg = f["seg"][:]
-    # segmentation must reproduce the true cells exactly (modulo label names):
-    # every true cell maps to exactly one segment id and vice versa
-    from itertools import product
+    _check_recovery(true, seg)
+
+    # the hierarchical solution must beat the ground-truth partition's
+    # objective on the actual cost instance (the solver is doing its job)
+    from cluster_tools_tpu import native
+    from cluster_tools_tpu.core import graph as g
+    nodes, edges, _ = g.load_graph(str(tmp_path / "problem.n5"), "s0/graph")
+    with file_reader(str(tmp_path / "problem.n5"), "r") as f:
+        costs = f["s0/costs"][:].astype("float64")
+    graph = g.Graph(nodes, edges)
+    uv = np.stack([graph.node_index(edges[:, 0]),
+                   graph.node_index(edges[:, 1])], 1)
+    frag2true = np.zeros(int(frags.max()) + 1, "uint64")
+    frag2true[frags.ravel()] = true.ravel()
+    gt_lab = frag2true[nodes.astype("int64")]
+    frag2seg = np.zeros(int(frags.max()) + 1, "uint64")
+    frag2seg[frags.ravel()] = seg.ravel()
+    got_lab = frag2seg[nodes.astype("int64")]
+    obj_gt = native.multicut_objective(uv, costs, gt_lab.astype("uint64"))
+    obj_got = native.multicut_objective(uv, costs, got_lab.astype("uint64"))
+    assert obj_got <= obj_gt + 1e-6, (obj_got, obj_gt)
+
+
+def test_full_chain_watershed_to_multicut(tmp_path, tmp_workdir):
+    """WatershedWorkflow -> MulticutSegmentationWorkflow, chained via
+    ``dependency`` exactly like the reference flagship
+    (workflows.py:222-227 + example/multicut.py:95-106)."""
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.workflows.segmentation import (
+        MulticutSegmentationWorkflow)
+    from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    true, _ = _nested_voronoi()
+    bnd = _boundary_map(true)
+
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.require_dataset("bmap", shape=bnd.shape, chunks=(12, 12, 12),
+                          dtype="float32")[:] = bnd
+
+    ws_wf = WatershedWorkflow(
+        input_path=path, input_key="bmap", output_path=path, output_key="ws",
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=2,
+        target="threads")
+    wf = MulticutSegmentationWorkflow(
+        input_path=path, input_key="bmap", ws_path=path, ws_key="ws",
+        problem_path=str(tmp_path / "problem.n5"), output_path=path,
+        output_key="seg", tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads", n_scales=1, dependency=ws_wf)
+    assert ctt.build([wf])
+
+    with file_reader(path, "r") as f:
+        ws = f["ws"][:]
+        seg = f["seg"][:]
+    assert (ws > 0).all()
+    # the multicut merges watershed fragments: fewer segments than fragments,
+    # and the big true cells dominate the voxel mass
+    assert len(np.unique(seg)) <= len(np.unique(ws))
+    ids, counts = np.unique(seg, return_counts=True)
+    share = np.sort(counts)[-4:].sum() / seg.size
+    assert share >= 0.80, f"top-4 segments cover only {share:.3f}"
+
+
+def _check_recovery(true, seg, n_true=4, min_share=0.95, min_rand=0.95):
+    """Well-posed recovery oracle for the synthetic nested-voronoi instance.
+
+    Exact bijective recovery is NOT achievable here: the 1-voxel-dilated
+    boundary band gives sliver fragments whose entire interface lies in the
+    band genuinely repulsive costs, so the *optimal* multicut splits them
+    (its objective beats the ground-truth partition's).  What a correct
+    pipeline must guarantee instead: no wrong merges across true cells, the
+    n_true dominant segments map 1:1 onto the true cells and carry almost
+    all voxels, and the Rand f-score is near 1.
+    """
     pairs = np.unique(np.stack([true.ravel(), seg.ravel()], 1), axis=0)
-    t_ids, s_ids = np.unique(pairs[:, 0]), np.unique(pairs[:, 1])
-    assert len(pairs) == len(t_ids) == len(s_ids), (
-        f"not a bijection: {len(pairs)} pairs, {len(t_ids)} true, "
-        f"{len(s_ids)} seg")
+    s_ids = np.unique(pairs[:, 1])
+    # every segment maps to exactly one true cell (no wrong merges)
+    assert len(pairs) == len(s_ids), (
+        f"wrong merges: {len(pairs)} (true, seg) pairs vs {len(s_ids)} segs")
+
+    ids, counts = np.unique(seg, return_counts=True)
+    order = np.argsort(-counts)
+    top = ids[order][:n_true]
+    share = counts[order][:n_true].sum() / seg.size
+    assert share >= min_share, f"top-{n_true} segments cover only {share:.3f}"
+    # the dominant segments hit each true cell exactly once
+    top_true = {int(pairs[pairs[:, 1] == s][0, 0]) for s in top}
+    assert len(top_true) == n_true, f"dominant segments map to {top_true}"
+
+    # rand f-score (precision/recall over voxel pairs)
+    joint = true.ravel().astype("uint64") * (seg.max() + 1) + seg.ravel()
+    _, cab = np.unique(joint, return_counts=True)
+    _, ca = np.unique(true, return_counts=True)
+    _, cb = np.unique(seg, return_counts=True)
+    sab = (cab.astype(float) ** 2).sum()
+    sa = (ca.astype(float) ** 2).sum()
+    sb = (cb.astype(float) ** 2).sum()
+    rand = 2.0 / (sb / sab + sa / sab)
+    assert rand >= min_rand, f"rand f-score {rand:.4f} < {min_rand}"
