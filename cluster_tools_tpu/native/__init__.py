@@ -10,6 +10,7 @@ degrades gracefully where no compiler exists.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -19,22 +20,40 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src", "solvers.cpp")
-_LIB_PATH = os.path.join(_HERE, "libctt_native.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
 
-def _build() -> bool:
+def _lib_path() -> Optional[str]:
+    """Content-addressed build artifact: the library name embeds the source
+    hash, so a stale binary (e.g. from a previous checkout — git does not
+    preserve mtimes) can never be loaded for edited sources."""
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    return os.path.join(_HERE, f"libctt_native-{digest}.so")
+
+
+def _build(lib_path: str) -> bool:
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
-           "-o", _LIB_PATH + ".tmp"]
+           "-o", lib_path + ".tmp"]
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=300)
     except (OSError, subprocess.TimeoutExpired):
         return False
     if res.returncode != 0:
         return False
-    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+    os.replace(lib_path + ".tmp", lib_path)
+    for name in os.listdir(_HERE):  # drop superseded build artifacts
+        if (name.startswith("libctt_native-") and name.endswith(".so")
+                and os.path.join(_HERE, name) != lib_path):
+            try:
+                os.unlink(os.path.join(_HERE, name))
+            except OSError:
+                pass
     return True
 
 
@@ -45,13 +64,12 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_LIB_PATH) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
-            if not _build():
-                _build_failed = True
-                return None
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib_path = _lib_path()
+        if lib_path is None or (not os.path.exists(lib_path)
+                                and not _build(lib_path)):
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(lib_path)
         i64 = ctypes.c_int64
         p_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         p_f64 = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")

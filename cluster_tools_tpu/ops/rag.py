@@ -24,6 +24,26 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def densify_labels(labels: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Map arbitrary (uint64) labels to dense int32 ids for device transfer.
+
+    JAX silently truncates int64 inputs to int32 unless x64 is enabled, and
+    watershed fragment labels carry per-block voxel offsets that exceed 2**31
+    at cluster scale.  Device kernels therefore always run on dense per-block
+    ids; callers map pair results back through the returned LUT.  Returns
+    (lut, dense) with ``lut[dense] == labels`` and ``lut[0] == 0`` so the
+    kernels' ignore-label-0 convention survives densification.
+    """
+    uniq, inv = np.unique(labels, return_inverse=True)
+    inv = inv.reshape(labels.shape)
+    if len(uniq) == 0 or uniq[0] != 0:
+        uniq = np.concatenate([np.zeros(1, dtype=uniq.dtype), uniq])
+        inv = inv + 1
+    if len(uniq) >= 2 ** 31:  # one block can never hold this many labels
+        raise ValueError("more than 2**31 distinct labels in one block")
+    return uniq.astype("uint64"), inv.astype("int32")
+
+
 def _axis_slices(ndim: int, axis: int, lo_size: int):
     lo = [slice(None)] * ndim
     hi = [slice(None)] * ndim

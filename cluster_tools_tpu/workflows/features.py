@@ -76,7 +76,7 @@ class BlockEdgeFeatures(BlockTask):
         import jax.numpy as jnp
 
         from ..ops.rag import (affinity_pair_values, boundary_pair_values,
-                               segmented_stats)
+                               densify_labels, segmented_stats)
 
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
@@ -84,9 +84,14 @@ class BlockEdgeFeatures(BlockTask):
         f_in = file_reader(cfg["input_path"], "r")
         f_lab = file_reader(cfg["labels_path"], "r")
         ds_in, ds_lab = f_in[cfg["input_key"]], f_lab[cfg["labels_key"]]
-        # integer inputs are quantized probabilities (uint8 convention);
-        # branching on dtype keeps the scaling identical across blocks
-        scale = 255.0 if np.issubdtype(ds_in.dtype, np.integer) else 1.0
+        # integer inputs are quantized probabilities scaled by the dtype's
+        # full range (uint8 -> /255, uint16 -> /65535, ...)
+        if np.issubdtype(ds_in.dtype, np.signedinteger):
+            raise ValueError(
+                f"signed integer probability maps are not supported "
+                f"(got {ds_in.dtype})")
+        scale = (float(np.iinfo(ds_in.dtype).max)
+                 if np.issubdtype(ds_in.dtype, np.integer) else 1.0)
         global_edges = None
         if offsets is not None:
             # affinity anchors are owned per-voxel, so an anchor's edge may
@@ -108,7 +113,7 @@ class BlockEdgeFeatures(BlockTask):
                 end = [min(e + int(r), s)
                        for e, r, s in zip(block.end, reach, cfg["shape"])]
             bb = tuple(slice(b, e) for b, e in zip(begin, end))
-            labels = ds_lab[bb].astype("int64")
+            lut, dense = densify_labels(ds_lab[bb])
             data = g.load_sub_graph(cfg["graph_path"], 0, block_id)
             edges, edge_ids = data["edges"], data["edge_ids"]
             # affinity mode must proceed even with an empty local sub-graph:
@@ -122,18 +127,19 @@ class BlockEdgeFeatures(BlockTask):
             if offsets is None:
                 bmap = ds_in[bb].astype("float32") / scale
                 u, v, val, ok = boundary_pair_values(
-                    jnp.asarray(labels), jnp.asarray(bmap),
+                    jnp.asarray(dense), jnp.asarray(bmap),
                     inner_shape=tuple(block.shape))
             else:
                 affs = ds_in[(slice(0, len(offsets)),) + bb].astype("float32")
                 affs /= scale
                 u, v, val, ok = affinity_pair_values(
-                    jnp.asarray(labels), jnp.asarray(affs), offsets,
+                    jnp.asarray(dense), jnp.asarray(affs), offsets,
                     inner_begin=tuple(b - bo for b, bo in
                                       zip(block.begin, begin)),
                     inner_shape=tuple(block.shape))
             m = np.asarray(ok)
-            uv = np.stack([np.asarray(u)[m], np.asarray(v)[m]], axis=1)
+            uv = np.stack([lut[np.asarray(u)[m]], lut[np.asarray(v)[m]]],
+                          axis=1)
             vals = np.asarray(val)[m].astype("float64")
             if offsets is None:
                 # boundary faces share the RAG's ownership rule, so every

@@ -59,7 +59,7 @@ class InitialSubGraphs(BlockTask):
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
         import jax.numpy as jnp
 
-        from ..ops.rag import label_pairs
+        from ..ops.rag import densify_labels, label_pairs
 
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
@@ -72,11 +72,12 @@ class InitialSubGraphs(BlockTask):
             end = [min(e + 1, s) for e, s in zip(block.end, cfg["shape"])]
             bb = tuple(slice(b, e) for b, e in zip(block.begin, end))
             labels = ds[bb]
-            u, v, ok = label_pairs(jnp.asarray(labels.astype("int64")),
+            lut, dense = densify_labels(labels)
+            u, v, ok = label_pairs(jnp.asarray(dense),
                                    ignore_label=ignore_label,
                                    inner_shape=tuple(block.shape))
             m = np.asarray(ok)
-            edges = g.unique_edges(np.asarray(u)[m], np.asarray(v)[m])
+            edges = g.unique_edges(lut[np.asarray(u)[m]], lut[np.asarray(v)[m]])
             nodes = np.unique(labels)
             if ignore_label:
                 nodes = nodes[nodes != 0]

@@ -145,9 +145,14 @@ class ReduceProblem(BlockTask):
     def run_impl(self):
         with file_reader(self.problem_path, "r") as f:
             shape = list(f["s0/graph"].attrs["shape"])
+        base_bs = self.global_block_shape()
+        scale_bs = [b * 2 ** self.scale for b in base_bs]
         self.run_jobs(None, {
             "problem_path": self.problem_path, "scale": self.scale,
-            "shape": shape, "block_shape": self.global_block_shape(),
+            "shape": shape, "block_shape": base_bs,
+            # ROI/mask-aware list of blocks SolveSubproblems must have
+            # produced; a missing sub_result is a hard error, not all-merge
+            "expected_blocks": self.blocks_in_volume(shape, scale_bs),
         })
 
     @classmethod
@@ -163,15 +168,27 @@ class ReduceProblem(BlockTask):
         uv_dense, n_nodes, s0_nodes = _load_scale_graph(problem_path, scale)
         costs = _load_costs(problem_path, scale)
 
-        # gather cut edges from all blocks at this scale
+        # gather cut edges from all blocks at this scale; a block whose
+        # sub_result is missing would silently contribute "merge everything"
+        # (ADVICE r1) — fail loudly instead
         scale_bs = [b * 2 ** scale for b in base_bs]
         blocking = Blocking(shape, scale_bs)
+        expected = cfg.get("expected_blocks")
+        if expected is None:
+            expected = list(range(blocking.n_blocks))
         cut_lists = []
-        for bid in range(blocking.n_blocks):
+        missing = []
+        for bid in expected:
             path = _sub_result_path(problem_path, scale, bid)
-            if os.path.exists(path):
-                with np.load(path) as d:
-                    cut_lists.append(d["cut_edge_ids"])
+            if not os.path.exists(path):
+                missing.append(bid)
+                continue
+            with np.load(path) as d:
+                cut_lists.append(d["cut_edge_ids"])
+        if missing:
+            raise RuntimeError(
+                f"missing sub_results for blocks {missing[:20]} at scale "
+                f"{scale} ({len(missing)} total)")
         cut_ids = (np.unique(np.concatenate(cut_lists)) if cut_lists
                    else np.zeros(0, "int64"))
         merge_mask = np.ones(len(uv_dense), bool)
