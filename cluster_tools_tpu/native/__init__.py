@@ -38,15 +38,17 @@ def _lib_path() -> Optional[str]:
 
 
 def _build(lib_path: str) -> bool:
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
-           "-o", lib_path + ".tmp"]
+    # per-process tmp name: concurrent workers may build simultaneously on
+    # first use; each publishes a complete file via atomic rename
+    tmp = f"{lib_path}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=300)
     except (OSError, subprocess.TimeoutExpired):
         return False
     if res.returncode != 0:
         return False
-    os.replace(lib_path + ".tmp", lib_path)
+    os.replace(tmp, lib_path)
     for name in os.listdir(_HERE):  # drop superseded build artifacts
         if (name.startswith("libctt_native-") and name.endswith(".so")
                 and os.path.join(_HERE, name) != lib_path):
