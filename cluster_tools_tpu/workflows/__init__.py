@@ -1,0 +1,15 @@
+"""User-facing workflow re-exports (reference: cluster_tools/__init__.py)."""
+
+from .graph import GraphWorkflow
+from .multicut import MulticutWorkflow
+from .mutex_watershed import MwsWorkflow, TwoPassMwsWorkflow
+from .relabel import RelabelWorkflow
+from .segmentation import MulticutSegmentationWorkflow, ProblemWorkflow
+from .thresholded_components import ThresholdedComponentsWorkflow
+from .watershed import WatershedWorkflow
+
+__all__ = [
+    "GraphWorkflow", "MulticutWorkflow", "MwsWorkflow", "TwoPassMwsWorkflow",
+    "RelabelWorkflow", "MulticutSegmentationWorkflow", "ProblemWorkflow",
+    "ThresholdedComponentsWorkflow", "WatershedWorkflow",
+]

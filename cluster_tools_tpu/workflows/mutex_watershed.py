@@ -1,0 +1,370 @@
+"""Blockwise mutex watershed over long-range affinities.
+
+Re-specification of the reference's ``mutex_watershed/`` package
+(mws_blocks.py:136-174, two_pass_mws.py:100-280, two_pass_assignments.py:26,
+mws_workflow.py).  Two stitching strategies, as in the reference:
+
+* **MwsWorkflow** — independent per-block MWS with per-block label offsets
+  and a consecutive relabel; no stitching (block boundaries stay cuts).
+* **TwoPassMwsWorkflow** — checkerboard two-pass: pass-1 blocks run plain
+  MWS; pass-2 blocks run *seeded* MWS where the halo-visible pass-1 labels
+  act as seeds, and the (segment, seed) co-occurrences are reconciled by a
+  global union-find into one assignment table.
+
+TPU-first deviation from the reference: the pass-1 "seed state" there is a
+serialized grid-graph edge dump per block (two_pass_mws.py:174-186 — marked
+FIXME-incorrect upstream); here seed consistency is expressed directly in the
+edge weights of the seeded pass (ops/mws.py: intra-seed edges get maximal
+attraction), which needs no inter-block state files beyond the label volume
+itself.  Edge extraction runs on device; the Kruskal clustering in first-party
+C++ (native.mutex_clustering).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.blocking import Blocking
+from ..core.runtime import BlockTask
+from ..core.storage import file_reader
+from ..core.workflow import FileTarget, Task
+from .relabel import RelabelWorkflow
+from .write import WriteAssignments
+
+
+def normalize(data: np.ndarray) -> np.ndarray:
+    """Affinities to float32 in [0, 1]; integer dtypes scale by their dtype
+    range (reference vu.normalize, utils/volume_utils.py:113-120)."""
+    if np.issubdtype(data.dtype, np.integer):
+        return data.astype("float32") / np.iinfo(data.dtype).max
+    data = data.astype("float32")
+    mx = data.max()
+    return data / mx if mx > 1.0 else data
+
+
+class MwsBlocksBase(BlockTask):
+    """Shared machinery for the single-pass and two-pass MWS block tasks."""
+
+    # pass_id: None = all blocks (single pass); 0/1 = checkerboard color
+    pass_id: Optional[int] = None
+    seeded: bool = False
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, offsets: Sequence[Sequence[int]],
+                 halo: Optional[Sequence[int]] = None,
+                 mask_path: str = "", mask_key: str = "", **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.offsets = [list(o) for o in offsets]
+        self.halo = list(halo) if halo is not None else None
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"strides": [1, 1, 1], "randomize_strides": False,
+                     "noise_level": 0.0})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            shape = list(f[self.input_key].shape)
+        assert len(shape) == 4, "need 4d (channel, spatial...) input for MWS"
+        n_channels, shape = shape[0], shape[1:]
+        assert n_channels == len(self.offsets), (n_channels, len(self.offsets))
+        block_shape = self.global_block_shape()[-len(shape):]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=shape, chunks=block_shape,
+                              dtype="uint64")
+        block_list = self.blocks_in_volume(shape, block_shape)
+        if self.pass_id is not None:
+            colors = Blocking(shape, block_shape).checkerboard()
+            allowed = set(block_list)
+            block_list = [b for b in colors[self.pass_id] if b in allowed]
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "offsets": self.offsets, "halo": self.halo,
+            "mask_path": self.mask_path, "mask_key": self.mask_key,
+            "shape": shape, "block_shape": block_shape,
+            "seeded": self.seeded,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from ..ops.mws import mutex_watershed_segmentation
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        halo = cfg["halo"]
+        seeded = cfg["seeded"]
+        f_in = file_reader(cfg["input_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
+        mask = None
+        if cfg.get("mask_path"):
+            from ..core.volume_views import load_mask
+
+            mask = load_mask(cfg["mask_path"], cfg["mask_key"], cfg["shape"])
+        # the per-block id budget must cover the halo-enlarged outer block:
+        # labels are compacted over the full outer region so halo-only
+        # segments keep valid global ids for the seed assignments
+        outer_shape = (cfg["block_shape"] if halo is None else
+                       [b + 2 * h for b, h in zip(cfg["block_shape"], halo)])
+        offset_unit = int(np.prod(outer_shape))
+
+        for block_id in job_config["block_list"]:
+            if halo is None:
+                block = blocking.get_block(block_id)
+                outer_bb = inner_bb = block.bb
+                local_bb = tuple(slice(None) for _ in cfg["shape"])
+            else:
+                bh = blocking.get_block_with_halo(block_id, halo)
+                outer_bb, inner_bb = bh.outer.bb, bh.inner.bb
+                local_bb = bh.inner_local.bb
+            bb_mask = None
+            if mask is not None:
+                bb_mask = np.asarray(mask[outer_bb]) > 0
+                if not bb_mask.any():
+                    log_fn(f"processed block {block_id}")
+                    continue
+            affs = normalize(ds_in[(slice(None),) + outer_bb])
+            if affs.sum() == 0:
+                log_fn(f"processed block {block_id}")
+                continue
+            seeds = None
+            if seeded:
+                # only voxels owned by the *other* checkerboard color carry
+                # finished pass-1 labels; halo parts of same-color (pass-2)
+                # neighbors may be written concurrently by other jobs, so
+                # mask them out — this both removes the read race and makes
+                # the result order-independent (the reference leaves this as
+                # an unresolved TODO, two_pass_mws.py:212-215)
+                seeds = np.asarray(ds_out[outer_bb])
+                own_color = sum(blocking.block_grid_position(block_id)) % 2
+                grids = np.meshgrid(
+                    *[np.arange(b.start, b.stop) // bs
+                      for b, bs in zip(outer_bb, cfg["block_shape"])],
+                    indexing="ij")
+                owner_color = sum(grids) % 2
+                seeds[owner_color == own_color] = 0
+            seg, seed_assignments = mutex_watershed_segmentation(
+                affs, cfg["offsets"], strides=cfg.get("strides"),
+                randomize_strides=cfg.get("randomize_strides", False),
+                mask=bb_mask, noise_level=cfg.get("noise_level", 0.0),
+                seed=block_id, seeds=seeds, return_seed_assignments=True)
+            # compact the full (outer) labeling so halo-only segments keep
+            # valid global ids for the seed assignments, then offset
+            nonzero = np.unique(seg[seg > 0])
+            if len(nonzero) >= offset_unit:
+                raise RuntimeError(
+                    f"block {block_id}: {len(nonzero)} labels exceed the "
+                    f"per-block offset budget {offset_unit}")
+            compact = np.searchsorted(nonzero, seg).astype("uint64")
+            compact += np.uint64(block_id * offset_unit + 1)
+            compact[seg == 0] = 0
+            ds_out[inner_bb] = compact[local_bb]
+            if seeded and len(seed_assignments):
+                # map the local segment column through compact+offset; keep
+                # only segments visible in the written crop or paired with a
+                # seed also seen by this block (reference: two_pass_mws.py
+                # :282-292 filters to crop ids)
+                seg_col = (np.searchsorted(
+                    nonzero, seed_assignments[:, 0]).astype("uint64")
+                    + np.uint64(block_id * offset_unit + 1))
+                pairs = np.stack(
+                    [seg_col, seed_assignments[:, 1].astype("uint64")], axis=1)
+                np.save(os.path.join(
+                    job_config["tmp_folder"],
+                    f"mws_two_pass_assignments_block_{block_id}.npy"), pairs)
+            log_fn(f"processed block {block_id}")
+
+
+class MwsBlocks(MwsBlocksBase):
+    """Single-pass blockwise MWS (reference: mws_blocks.py)."""
+
+    task_name = "mws_blocks"
+
+
+class MwsPass1(MwsBlocksBase):
+    """Checkerboard color-0 blocks, plain MWS (two_pass_mws.py pass 0)."""
+
+    task_name = "mws_pass1"
+    pass_id = 0
+
+
+class MwsPass2(MwsBlocksBase):
+    """Checkerboard color-1 blocks, seeded by pass-1 halo labels
+    (two_pass_mws.py pass 1)."""
+
+    task_name = "mws_pass2"
+    pass_id = 1
+    seeded = True
+
+
+class TwoPassAssignments(BlockTask):
+    """Global union-find over the pass-2 (segment, seed) pairs -> sparse
+    consecutive assignment table (reference: two_pass_assignments.py:90-150,
+    with the intermediate RelabelWorkflow folded in: the table domain is the
+    set of ids actually present, collected by FindUniques)."""
+
+    task_name = "two_pass_assignments"
+    global_task = True
+    allow_retry = False
+
+    def __init__(self, assignment_path: str, uniques_prefix: str, **kw):
+        self.assignment_path = assignment_path
+        self.uniques_prefix = uniques_prefix
+        super().__init__(**kw)
+
+    def run_impl(self):
+        self.run_jobs(None, {
+            "tmp_root": self.tmp_folder,
+            "uniques_prefix": self.uniques_prefix,
+            "assignment_path": self.assignment_path,
+        })
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from .. import native
+
+        cfg = job_config["config"]
+        tmp = cfg["tmp_root"]
+        uniques = []
+        prefix = cfg["uniques_prefix"] + "_out_"
+        for name in os.listdir(tmp):
+            if name.startswith(prefix) and name.endswith(".npy"):
+                uniques.append(np.load(os.path.join(tmp, name)))
+        ids = np.unique(np.concatenate(uniques)) if uniques else np.zeros(0, "uint64")
+        if ids.size == 0 or ids[0] != 0:
+            ids = np.concatenate([np.zeros(1, "uint64"), ids])
+        pair_arrays = [np.zeros((0, 2), "uint64")]
+        for name in os.listdir(tmp):
+            if (name.startswith("mws_two_pass_assignments_block_")
+                    and name.endswith(".npy")):
+                pair_arrays.append(np.load(os.path.join(tmp, name)))
+        pairs = np.concatenate(pair_arrays, axis=0)
+        # pairs may mention halo-only segment ids absent from the volume;
+        # include them as union-find nodes so transitive merges survive
+        domain = np.unique(np.concatenate([ids, pairs.ravel()]))
+        compact_pairs = np.searchsorted(domain, pairs)
+        roots = native.ufd_merge_pairs(len(domain), compact_pairs)
+        # consecutive relabel over the ids present in the volume, 0 stays 0
+        vol_roots = roots[np.searchsorted(domain, ids)]
+        nz_roots = vol_roots[ids != 0]
+        uniq_roots = np.unique(nz_roots)
+        new_ids = np.zeros(len(ids), dtype="uint64")
+        new_ids[ids != 0] = np.searchsorted(uniq_roots, nz_roots) + 1
+        table = np.stack([ids, new_ids], axis=1)
+        np.save(cfg["assignment_path"], table)
+        log_fn(f"merged {len(pairs)} seed pairs over {len(ids)} ids -> "
+               f"{len(uniq_roots)} segments")
+
+
+class MwsWorkflow(Task):
+    """MwsBlocks -> RelabelWorkflow (reference: mws_workflow.py:12-56)."""
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, offsets: Sequence[Sequence[int]],
+                 tmp_folder: str, config_dir: str, max_jobs: int = 1,
+                 target: str = "local", halo: Optional[Sequence[int]] = None,
+                 mask_path: str = "", mask_key: str = "",
+                 dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.offsets = offsets
+        self.halo = halo
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def _common(self):
+        return dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                    max_jobs=self.max_jobs, target=self.target)
+
+    def requires(self):
+        t1 = MwsBlocks(
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key=self.output_key,
+            offsets=self.offsets, halo=self.halo,
+            mask_path=self.mask_path, mask_key=self.mask_key,
+            dependency=self.dependency, **self._common())
+        return RelabelWorkflow(
+            input_path=self.output_path, input_key=self.output_key,
+            identifier="mws_relabel", dependency=t1, **self._common())
+
+    def output(self):
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "write_mws_relabel.status"))
+
+
+class TwoPassMwsWorkflow(Task):
+    """MwsPass1 -> MwsPass2 (seeded) -> FindUniques -> TwoPassAssignments ->
+    Write (reference: mws_workflow.py:59-125)."""
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, offsets: Sequence[Sequence[int]],
+                 halo: Sequence[int], tmp_folder: str, config_dir: str,
+                 max_jobs: int = 1, target: str = "local",
+                 mask_path: str = "", mask_key: str = "",
+                 dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.offsets = offsets
+        self.halo = list(halo)
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def _common(self):
+        return dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                    max_jobs=self.max_jobs, target=self.target)
+
+    def requires(self):
+        from .relabel import FindUniques
+
+        kw = dict(input_path=self.input_path, input_key=self.input_key,
+                  output_path=self.output_path, output_key=self.output_key,
+                  offsets=self.offsets, halo=self.halo,
+                  mask_path=self.mask_path, mask_key=self.mask_key)
+        t1 = MwsPass1(dependency=self.dependency, **kw, **self._common())
+        t2 = MwsPass2(dependency=t1, **kw, **self._common())
+        t3 = FindUniques(input_path=self.output_path,
+                         input_key=self.output_key,
+                         identifier="two_pass_mws", dependency=t2,
+                         **self._common())
+        assignment_path = os.path.join(self.tmp_folder,
+                                       "two_pass_mws_assignments.npy")
+        t4 = TwoPassAssignments(assignment_path=assignment_path,
+                                uniques_prefix=t3.name_with_id,
+                                dependency=t3, **self._common())
+        return WriteAssignments(
+            input_path=self.output_path, input_key=self.output_key,
+            output_path=self.output_path, output_key=self.output_key,
+            assignment_path=assignment_path, identifier="two_pass_mws",
+            dependency=t4, **self._common())
+
+    def output(self):
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "write_two_pass_mws.status"))
