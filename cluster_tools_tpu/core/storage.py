@@ -239,13 +239,25 @@ class _TSContainer:
     def require_dataset(
         self,
         key: str,
-        shape: Sequence[int],
-        chunks: Sequence[int],
-        dtype,
+        shape: Optional[Sequence[int]] = None,
+        chunks: Optional[Sequence[int]] = None,
+        dtype=None,
         compression: str = "raw",
+        data: Optional[np.ndarray] = None,
         **_ignored: Any,
     ) -> Dataset:
-        """Create-if-absent (reference: watershed/watershed.py:82-84)."""
+        """Create-if-absent (reference: watershed/watershed.py:82-84).
+
+        ``data=`` is the z5py/h5py convenience: infer shape/dtype from the
+        array and write it after creation.
+        """
+        if data is not None:
+            data = np.asarray(data)
+            shape = data.shape if shape is None else shape
+            dtype = data.dtype if dtype is None else dtype
+        if shape is None or dtype is None:
+            raise TypeError("require_dataset needs shape+dtype or data=")
+        chunks = tuple(shape) if chunks is None else chunks
         target = os.path.join(self.path, key)
         exists = self._is_dataset(key)
         if not exists:
@@ -257,6 +269,10 @@ class _TSContainer:
             raise ValueError(
                 f"existing dataset {key} has shape {ds.shape}, requested {tuple(shape)}"
             )
+        if data is not None and not exists:
+            # h5py/z5py semantics: data= fills the dataset only on creation;
+            # an existing dataset (resumed workflow) is returned untouched
+            ds[tuple(slice(0, s) for s in shape)] = data
         return ds  # type: ignore[return-value]
 
     create_dataset = require_dataset
@@ -417,13 +433,24 @@ class H5File:
 
     create_group = require_group
 
-    def require_dataset(self, key, shape, chunks, dtype, compression=None, **kw):
+    def require_dataset(self, key, shape=None, chunks=None, dtype=None,
+                        compression=None, data=None, **kw):
         if compression == "raw":
             compression = None
+        if data is not None:
+            data = np.asarray(data)
+            shape = data.shape if shape is None else shape
+            dtype = data.dtype if dtype is None else dtype
+        if shape is None or dtype is None:
+            raise TypeError("require_dataset needs shape+dtype or data=")
+        chunks = tuple(shape) if chunks is None else tuple(chunks)
+        exists = key in self._f
         ds = self._f.require_dataset(
-            key, shape=tuple(shape), chunks=tuple(chunks), dtype=dtype,
+            key, shape=tuple(shape), chunks=chunks, dtype=dtype,
             compression=compression,
         )
+        if data is not None and not exists:
+            ds[...] = data
         return _H5Dataset(ds)
 
     create_dataset = require_dataset

@@ -1,0 +1,72 @@
+"""First-party model checkpoints for the inference workflow.
+
+The reference loads externally-trained torch checkpoints
+(reference: inference/frameworks.py:32-64 ``PytorchPredicter`` —
+``torch.load(checkpoint_path)``); the TPU framework owns its models, so a
+checkpoint is a plain directory:
+
+    <path>/model.json   — constructor kwargs for :func:`models.unet.create_unet`
+    <path>/params.npz   — flattened param pytree, one array per entry
+
+No orbax dependency: npz + json restore bit-exactly, are human-inspectable,
+and avoid a heavyweight async checkpoint manager for what is a few MB of
+conv kernels.  (Orbax remains the right tool for sharded multi-host training
+states; these checkpoints are the *inference* interchange format.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def save_checkpoint(path: str, model_config: Dict[str, Any], params: Any) -> None:
+    """Write ``model.json`` + ``params.npz``.
+
+    ``model_config`` holds the kwargs of :func:`models.unet.create_unet`
+    (``out_channels``, ``features``, ``anisotropic``).
+    """
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "model.json"), "w") as f:
+        json.dump(model_config, f)
+    flat = _flatten(params)
+    np.savez(os.path.join(path, "params.npz"), **flat)
+
+
+def load_checkpoint(path: str) -> Tuple[Any, Dict[str, Any]]:
+    """Return ``(model, params)`` rebuilt from a checkpoint directory."""
+    from .unet import create_unet
+
+    with open(os.path.join(path, "model.json")) as f:
+        model_config = json.load(f)
+    model_config = dict(model_config)
+    if "features" in model_config:
+        model_config["features"] = tuple(model_config["features"])
+    model = create_unet(**model_config)
+    with np.load(os.path.join(path, "params.npz")) as data:
+        flat = {k: data[k] for k in data.files}
+    return model, _unflatten(flat)

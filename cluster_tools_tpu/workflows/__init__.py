@@ -1,6 +1,7 @@
 """User-facing workflow re-exports (reference: cluster_tools/__init__.py)."""
 
 from .graph import GraphWorkflow
+from .inference import InferenceTask
 from .multicut import MulticutWorkflow
 from .mutex_watershed import MwsWorkflow, TwoPassMwsWorkflow
 from .relabel import RelabelWorkflow
@@ -9,7 +10,8 @@ from .thresholded_components import ThresholdedComponentsWorkflow
 from .watershed import WatershedWorkflow
 
 __all__ = [
-    "GraphWorkflow", "MulticutWorkflow", "MwsWorkflow", "TwoPassMwsWorkflow",
+    "GraphWorkflow", "InferenceTask", "MulticutWorkflow", "MwsWorkflow",
+    "TwoPassMwsWorkflow",
     "RelabelWorkflow", "MulticutSegmentationWorkflow", "ProblemWorkflow",
     "ThresholdedComponentsWorkflow", "WatershedWorkflow",
 ]

@@ -1,0 +1,332 @@
+"""Blockwise neural-network inference (BASELINE config 5).
+
+TPU-native re-specification of the reference's distributed CNN prediction
+(reference: inference/inference.py — halo + reflect-pad loads :202-232, the
+dask-delayed load->preprocess->predict->write pipeline overlapping IO and GPU
+:244-343, multi-dataset channel mapping :87-104, uint8 requantization
+:235-241, mask-skip :268-276).  Differences by design:
+
+* The model is first-party (flax 3D U-Net, models/unet.py) loaded from a
+  framework checkpoint (models/checkpoint.py) instead of an external torch
+  pickle; the forward pass is one jitted XLA program compiled once per job —
+  every block has the same padded outer shape, so there is exactly one
+  compilation.
+* Input normalization (zero-mean/unit-variance, the reference's preprocessor
+  — inference/frameworks.py:137-161) and the reflect-padding up to the
+  U-Net's divisibility constraint are fused *into* the jitted program: the
+  host hands the raw outer block to the device and gets the cropped
+  prediction back, nothing else runs per-voxel on the host.
+* IO/compute overlap keeps the dask shape but with plain threads: a prefetch
+  pool reads upcoming blocks (tensorstore releases the GIL), the main thread
+  streams them through the device, a writer pool commits the outputs.  The
+  device is never idle waiting for the filesystem.
+* A multi-chip variant shards the batch of outer blocks over the mesh 'data'
+  axis, turning block-parallelism into chip-parallelism with zero
+  inter-chip traffic (blocks are independent; halos come from the store).
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.blocking import Blocking
+from ..core.runtime import BlockTask
+from ..core.storage import file_reader
+
+
+def load_with_halo(ds, offset: Sequence[int], block_shape: Sequence[int],
+                   halo: Sequence[int], padding_mode: str = "reflect",
+                   channel_slice: Optional[slice] = None) -> np.ndarray:
+    """Read ``[offset-halo, offset+block_shape+halo)`` with out-of-volume
+    parts reflect-padded (reference: inference/inference.py:202-232
+    ``_load_input``).  Always returns the full outer shape, so downstream
+    device programs see one static shape for every block."""
+    shape = ds.shape[-len(offset):]
+    starts = [off - ha for off, ha in zip(offset, halo)]
+    stops = [off + bs + ha for off, bs, ha in zip(offset, block_shape, halo)]
+    pad_left = tuple(max(0, -s) for s in starts)
+    pad_right = tuple(max(0, stop - sh) for stop, sh in zip(stops, shape))
+    bb = tuple(slice(max(0, s), min(sh, stop))
+               for s, stop, sh in zip(starts, stops, shape))
+    if channel_slice is not None:
+        bb = (channel_slice,) + bb
+        pad_left = (0,) + pad_left
+        pad_right = (0,) + pad_right
+    data = ds[bb]
+    if any(pad_left) or any(pad_right):
+        data = np.pad(data, tuple(zip(pad_left, pad_right)), mode=padding_mode)
+    return data
+
+
+def to_uint8(data: np.ndarray, float_range=(0.0, 1.0),
+             safe_scale: bool = True) -> np.ndarray:
+    """Requantize float predictions to uint8 (reference:
+    inference/inference.py:235-241 ``_to_uint8``)."""
+    if safe_scale:
+        mult = np.floor(255.0 / (float_range[1] - float_range[0]))
+    else:
+        mult = np.ceil(255.0 / (float_range[1] - float_range[0]))
+    add = 255 - mult * float_range[1]
+    return np.clip((data * mult + add).round(), 0, 255).astype("uint8")
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def make_predictor(checkpoint_path: str, outer_shape: Sequence[int],
+                   halo: Sequence[int], preprocess: str = "standardize"):
+    """Build the jitted block predictor.
+
+    Accepts ``(*outer_shape)`` single-channel or ``(C, *outer_shape)``
+    multi-channel raw blocks; returns ``(C_out, *inner_shape)`` float32.  The
+    jitted program does: standardize -> reflect-pad to the U-Net divisor ->
+    forward -> crop pad -> crop halo -> channels-first.  One compile per job
+    (static outer shape).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.checkpoint import load_checkpoint
+
+    model, params = load_checkpoint(checkpoint_path)
+    div = model.min_divisor()
+    padded = tuple(_round_up(s, d) for s, d in zip(outer_shape, div))
+    pad = tuple((0, p - s) for p, s in zip(padded, outer_shape))
+    inner = tuple(slice(h, s - h) for s, h in zip(outer_shape, halo))
+
+    @jax.jit
+    def _predict(params, x):
+        # x: (*outer, C) channels-last
+        x = x.astype(jnp.float32)
+        if preprocess == "standardize":
+            # zero-mean/unit-variance per channel (reference preprocessor,
+            # inference/frameworks.py:137-161)
+            mean = x.mean(axis=(0, 1, 2), keepdims=True)
+            std = jnp.maximum(x.std(axis=(0, 1, 2), keepdims=True), 1e-6)
+            x = (x - mean) / std
+        elif preprocess == "normalize":
+            lo = x.min(axis=(0, 1, 2), keepdims=True)
+            hi = x.max(axis=(0, 1, 2), keepdims=True)
+            x = (x - lo) / jnp.maximum(hi - lo, 1e-6)
+        x = jnp.pad(x, pad + ((0, 0),), mode="reflect")
+        pred = model.apply(params, x[None])[0]
+        pred = pred[tuple(slice(0, s) for s in outer_shape)]
+        pred = pred[inner]
+        return jnp.moveaxis(pred, -1, 0)  # channels-first like the reference
+
+    def predict(block: np.ndarray) -> np.ndarray:
+        if block.ndim == len(outer_shape) + 1:  # (C, *outer) -> channels-last
+            block = np.moveaxis(block, 0, -1)
+        else:
+            block = block[..., None]
+        return np.asarray(_predict(params, jnp.asarray(block)), dtype="float32")
+
+    return predict
+
+
+class InferenceTask(BlockTask):
+    """Blockwise model prediction (reference: InferenceBase,
+    inference/inference.py:25-137).
+
+    ``output_key`` is a dict ``{dataset_key: [channel_begin, channel_end]}``
+    (reference channel mapping, inference.py:87-104): each output dataset
+    receives the given slice of prediction channels; single-channel outputs
+    are written as plain 3D volumes.
+    """
+
+    task_name = "inference"
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: Dict[str, Sequence[int]], checkpoint_path: str,
+                 halo: Sequence[int], mask_path: str = "", mask_key: str = "",
+                 **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = dict(output_key)
+        self.checkpoint_path = checkpoint_path
+        self.halo = list(halo)
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"dtype": "uint8", "preprocess": "standardize",
+                     "channel_begin": 0, "channel_end": None})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            in_shape = f[self.input_key].shape
+        shape = list(in_shape[-3:])
+        block_shape = self.global_block_shape()[-3:]
+        dtype = self.task_config.get("dtype", "uint8")
+        assert dtype in ("uint8", "float32")
+
+        with file_reader(self.output_path) as f:
+            for out_key, (c0, c1) in self.output_key.items():
+                n_channels = c1 - c0
+                assert n_channels > 0
+                if n_channels > 1:
+                    f.require_dataset(out_key, shape=(n_channels, *shape),
+                                      chunks=[1] + block_shape, dtype=dtype)
+                else:
+                    f.require_dataset(out_key, shape=shape,
+                                      chunks=block_shape, dtype=dtype)
+
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "output_path": self.output_path,
+            "output_keys": list(self.output_key.keys()),
+            "channel_mapping": [list(v) for v in self.output_key.values()],
+            "checkpoint_path": self.checkpoint_path, "halo": self.halo,
+            "mask_path": self.mask_path, "mask_key": self.mask_key,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        shape, block_shape = cfg["shape"], cfg["block_shape"]
+        halo = cfg["halo"]
+        blocking = Blocking(shape, block_shape)
+        f_in = file_reader(cfg["input_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in = f_in[cfg["input_key"]]
+        ds_outs = [f_out[k] for k in cfg["output_keys"]]
+        channel_mapping = cfg["channel_mapping"]
+        dtype = np.dtype(cfg.get("dtype", "uint8"))
+
+        mask = None
+        if cfg.get("mask_path"):
+            from ..core.volume_views import load_mask
+
+            mask = load_mask(cfg["mask_path"], cfg["mask_key"], shape)
+
+        outer_shape = tuple(bs + 2 * h for bs, h in zip(block_shape, halo))
+        predict = make_predictor(cfg["checkpoint_path"], outer_shape, halo,
+                                 cfg.get("preprocess", "standardize"))
+        n_threads = int(cfg.get("threads_per_job", 1)) or 1
+
+        # channel selection for 4D (C, Z, Y, X) inputs (reference channel
+        # handling: watershed.py:267-283 reads a channel range)
+        channel_slice = None
+        if len(ds_in.shape) == 4:
+            c0 = int(cfg.get("channel_begin") or 0)
+            c1 = cfg.get("channel_end")
+            channel_slice = slice(c0, ds_in.shape[0] if c1 is None else int(c1))
+
+        def _load(block_id: int):
+            block = blocking.get_block(block_id)
+            if mask is not None:
+                bb = block.bb
+                if not np.any(np.asarray(mask[bb])):
+                    return block_id, None, None
+            data = load_with_halo(ds_in, block.begin, block_shape, halo,
+                                  channel_slice=channel_slice)
+            return block_id, block, data
+
+        def _write(block_id: int, block, pred: np.ndarray):
+            # crop to the actual (volume-clipped) inner extent
+            actual = [e - b for b, e in zip(block.begin, block.end)]
+            pred = pred[(slice(None),) + tuple(slice(0, a) for a in actual)]
+            if dtype == np.uint8:
+                pred = to_uint8(pred)
+            for ds_out, (c0, c1) in zip(ds_outs, channel_mapping):
+                out = pred[c0:c1]
+                if c1 - c0 == 1:
+                    ds_out[block.bb] = out[0].astype(dtype)
+                else:
+                    ds_out[(slice(None),) + block.bb] = out.astype(dtype)
+            return block_id
+
+        block_list = list(job_config["block_list"])
+        # prefetch reads and defer writes on thread pools; device compute
+        # stays on this thread — the TPU analog of the reference's dask
+        # threaded pipeline (inference.py:336-343).  The look-ahead window is
+        # bounded (2*n_threads loads in flight, writes drained at the same
+        # lag) so host memory stays constant regardless of job size.
+        window = 2 * n_threads
+        from collections import deque
+
+        with ThreadPoolExecutor(n_threads) as read_pool, \
+                ThreadPoolExecutor(n_threads) as write_pool:
+            loads = deque(read_pool.submit(_load, b)
+                          for b in block_list[:window])
+            next_block = window
+            writes = deque()
+            while loads:
+                block_id, block, data = loads.popleft().result()
+                if next_block < len(block_list):
+                    loads.append(read_pool.submit(_load,
+                                                  block_list[next_block]))
+                    next_block += 1
+                if data is None:
+                    log_fn(f"processed block {block_id}")
+                    continue
+                pred = predict(data)
+                writes.append((block_id,
+                               write_pool.submit(_write, block_id, block, pred)))
+                while len(writes) > window:
+                    done_id, w = writes.popleft()
+                    w.result()
+                    log_fn(f"processed block {done_id}")
+            for done_id, w in writes:
+                w.result()
+                log_fn(f"processed block {done_id}")
+
+
+def predict_sharded(checkpoint_path: str, volume: np.ndarray,
+                    n_devices: Optional[int] = None,
+                    preprocess: str = "standardize") -> np.ndarray:
+    """Multi-chip single-program variant: shard a batch of outer blocks over
+    the mesh 'data' axis and run one pjit forward.  Blocks are independent
+    (halos come from the store), so this is pure chip-parallelism with no
+    inter-chip traffic — the TPU analog of the reference's one-GPU-per-job
+    device mapping (inference/inference.py:370-375).
+
+    ``volume``: ``(N, D, H, W)`` stacked outer blocks; returns
+    ``(N, C, D, H, W)`` float32 predictions.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models.checkpoint import load_checkpoint
+    from ..parallel import mesh as mesh_lib
+
+    model, params = load_checkpoint(checkpoint_path)
+    mesh = mesh_lib.make_mesh(n_devices or jax.device_count())
+    div = model.min_divisor()
+    n, *spatial = volume.shape
+    padded = tuple(_round_up(s, d) for s, d in zip(spatial, div))
+    pad = ((0, 0),) + tuple((0, p - s) for p, s in zip(padded, spatial))
+    dp = mesh.shape["data"]
+    n_pad = _round_up(max(n, dp), dp)
+
+    @jax.jit
+    def fwd(params, x):
+        x = x.astype(jnp.float32)
+        if preprocess == "standardize":
+            mean = x.mean(axis=(1, 2, 3), keepdims=True)
+            std = jnp.maximum(x.std(axis=(1, 2, 3), keepdims=True), 1e-6)
+            x = (x - mean) / std
+        x = jnp.pad(x, pad, mode="reflect")
+        pred = model.apply(params, x[..., None])
+        pred = pred[:, :spatial[0], :spatial[1], :spatial[2]]
+        return jnp.moveaxis(pred, -1, 1)
+
+    batch = np.zeros((n_pad, *spatial), volume.dtype)
+    batch[:n] = volume
+    x_shard = NamedSharding(mesh, P("data", None, None, None))
+    xj = jax.device_put(jnp.asarray(batch), x_shard)
+    out = np.asarray(fwd(params, xj), dtype="float32")
+    return out[:n]
