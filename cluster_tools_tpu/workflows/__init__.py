@@ -6,12 +6,16 @@ from .multicut import MulticutWorkflow
 from .mutex_watershed import MwsWorkflow, TwoPassMwsWorkflow
 from .relabel import RelabelWorkflow
 from .segmentation import MulticutSegmentationWorkflow, ProblemWorkflow
+from .stitching import StitchingAssignmentsWorkflow, StitchingWorkflow
 from .thresholded_components import ThresholdedComponentsWorkflow
-from .watershed import WatershedWorkflow
+from .watershed import (AgglomerateTask, WatershedFromSeedsTask,
+                        WatershedWorkflow)
 
 __all__ = [
-    "GraphWorkflow", "InferenceTask", "MulticutWorkflow", "MwsWorkflow",
-    "TwoPassMwsWorkflow",
+    "AgglomerateTask", "GraphWorkflow", "InferenceTask", "MulticutWorkflow",
+    "MwsWorkflow", "TwoPassMwsWorkflow",
     "RelabelWorkflow", "MulticutSegmentationWorkflow", "ProblemWorkflow",
-    "ThresholdedComponentsWorkflow", "WatershedWorkflow",
+    "StitchingAssignmentsWorkflow", "StitchingWorkflow",
+    "ThresholdedComponentsWorkflow", "WatershedFromSeedsTask",
+    "WatershedWorkflow",
 ]

@@ -319,6 +319,10 @@ def predict_sharded(checkpoint_path: str, volume: np.ndarray,
             mean = x.mean(axis=(1, 2, 3), keepdims=True)
             std = jnp.maximum(x.std(axis=(1, 2, 3), keepdims=True), 1e-6)
             x = (x - mean) / std
+        elif preprocess == "normalize":
+            lo = x.min(axis=(1, 2, 3), keepdims=True)
+            hi = x.max(axis=(1, 2, 3), keepdims=True)
+            x = (x - lo) / jnp.maximum(hi - lo, 1e-6)
         x = jnp.pad(x, pad, mode="reflect")
         pred = model.apply(params, x[..., None])
         pred = pred[:, :spatial[0], :spatial[1], :spatial[2]]

@@ -119,15 +119,87 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
     return ws.astype("uint64")
 
 
+def run_ws_block_seeded(data: np.ndarray, cfg: Dict[str, Any],
+                        initial_seeds: np.ndarray, label_offset: int,
+                        mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """Watershed continuing existing labels: ``initial_seeds`` (uint64,
+    0 = free) keep their ids; new seeds from DT maxima in unlabeled areas get
+    ids offset by ``label_offset`` (reference: two_pass_watershed.py:210-255
+    ``_ws_pass2`` / ``_apply_watershed_with_seeds``).  3d only — the 2d
+    variants cannot propagate seeds across slices."""
+    import jax.numpy as jnp
+
+    from ..ops.components import connected_components
+    from ..ops.edt import distance_transform_edt
+    from ..ops.filters import gaussian, local_maxima
+    from ..ops.watershed import seeded_watershed
+
+    threshold = cfg.get("threshold", 0.25)
+    sigma_seeds = cfg.get("sigma_seeds", 2.0)
+    sigma_weights = cfg.get("sigma_weights", 2.0)
+    alpha = cfg.get("alpha", 0.8)
+    pixel_pitch = cfg.get("pixel_pitch")
+
+    x = jnp.asarray(data)
+    jmask = None if mask is None else jnp.asarray(mask.astype(bool))
+    fg = x < threshold
+    if jmask is not None:
+        fg = fg & jmask
+    sampling = tuple(pixel_pitch) if pixel_pitch else None
+    dt = distance_transform_edt(fg, sampling=sampling)
+    hmap = gaussian(x, sigma_weights) if sigma_weights else x
+    dmax = jnp.maximum(dt.max(), 1e-6)
+    height = alpha * hmap + (1.0 - alpha) * (1.0 - dt / dmax)
+
+    # densify initial seeds to 1..k for the device program (lut[0] == 0)
+    from ..ops.rag import densify_labels
+
+    lut, dense_init = densify_labels(initial_seeds)
+    k = len(lut) - 1
+
+    seeded_area = jnp.asarray(initial_seeds > 0)
+    dt_smooth = gaussian(dt, sigma_seeds) if sigma_seeds else dt
+    maxima = local_maxima(dt_smooth, radius=2) & fg & ~seeded_area
+    new_cc = connected_components(maxima, connectivity=data.ndim)
+    combined = jnp.where(jnp.asarray(dense_init) > 0, jnp.asarray(dense_init),
+                         jnp.where(new_cc > 0, new_cc + k, 0))
+    ws = np.asarray(seeded_watershed(height, combined, jmask, connectivity=1))
+
+    # map back: 1..k -> original seed ids; >k -> compacted + offset
+    out = np.zeros(ws.shape, dtype="uint64")
+    init_part = (ws >= 1) & (ws <= k)
+    if k:
+        out[init_part] = lut[ws[init_part]]
+    new_part = ws > k
+    if new_part.any():
+        new_ids = np.unique(ws[new_part])
+        if cfg.get("id_budget") and len(new_ids) >= cfg["id_budget"]:
+            raise RuntimeError(
+                f"{len(new_ids)} new seeds exceed the per-block id budget "
+                f"{cfg['id_budget']} — labels would collide across blocks")
+        out[new_part] = (np.searchsorted(new_ids, ws[new_part])
+                         .astype("uint64") + np.uint64(label_offset) + 1)
+    return out
+
+
 class WatershedTask(BlockTask):
     """Blockwise DT watershed (reference: WatershedBase, watershed.py:34-110).
 
     Labels are made globally unique by offsetting with
     ``block_id * prod(block_shape)`` (reference: watershed.py:307); chain
     RelabelWorkflow (or use WatershedWorkflow) to compact them.
+
+    ``pass_id``/``seeded`` implement the checkerboard two-pass variant
+    (reference: two_pass_watershed.py:60-94): color-0 blocks run the plain
+    pipeline; color-1 blocks read the pass-1 labels visible in their halo and
+    continue them as seeds — block boundaries between the two colors need no
+    stitching.
     """
 
     task_name = "watershed"
+    #: None = all blocks (single pass); 0/1 = checkerboard color
+    pass_id: Optional[int] = None
+    seeded: bool = False
 
     def __init__(self, input_path: str, input_key: str, output_path: str,
                  output_key: str, mask_path: str = "", mask_key: str = "", **kw):
@@ -160,11 +232,16 @@ class WatershedTask(BlockTask):
             f.require_dataset(self.output_key, shape=shape, chunks=block_shape,
                               dtype="uint64")
         block_list = self.blocks_in_volume(shape, block_shape)
+        if self.pass_id is not None:
+            colors = Blocking(shape, block_shape).checkerboard()
+            allowed = set(block_list)
+            block_list = [b for b in colors[self.pass_id] if b in allowed]
         self.run_jobs(block_list, {
             "input_path": self.input_path, "input_key": self.input_key,
             "output_path": self.output_path, "output_key": self.output_key,
             "mask_path": self.mask_path, "mask_key": self.mask_key,
             "shape": shape, "block_shape": block_shape,
+            "seeded": self.seeded,
         }, n_jobs=self.max_jobs)
 
     @classmethod
@@ -183,6 +260,7 @@ class WatershedTask(BlockTask):
             mask = load_mask(cfg["mask_path"], cfg["mask_key"], cfg["shape"])
 
         label_offset_unit = np.uint64(np.prod(cfg["block_shape"]))
+        seeded = cfg.get("seeded", False)
         for block_id in job_config["block_list"]:
             bh = blocking.get_block_with_halo(block_id, halo)
             data = _read_input(ds_in, bh.outer.bb, cfg)
@@ -192,6 +270,24 @@ class WatershedTask(BlockTask):
                 if not bmask.any():
                     log_fn(f"processed block {block_id}")
                     continue
+            if seeded:
+                # pass-2: labels already written by the other checkerboard
+                # color act as seeds; same-color owners (possibly being
+                # written concurrently) are masked out so the result is
+                # order-independent
+                seeds = np.asarray(ds_out[bh.outer.bb])
+                own_color = sum(blocking.block_grid_position(block_id)) % 2
+                grids = np.meshgrid(
+                    *[np.arange(b.start, b.stop) // bs
+                      for b, bs in zip(bh.outer.bb, cfg["block_shape"])],
+                    indexing="ij")
+                seeds[sum(grids) % 2 == own_color] = 0
+                ws = run_ws_block_seeded(
+                    data, {**cfg, "id_budget": int(label_offset_unit)}, seeds,
+                    int(np.uint64(block_id) * label_offset_unit), bmask)
+                ds_out[bh.inner.bb] = ws[bh.inner_local.bb]
+                log_fn(f"processed block {block_id}")
+                continue
             ws = run_ws_block(data, cfg, bmask)
             inner = ws[bh.inner_local.bb]
             # compact to 1..k (k <= inner voxel count < offset unit), THEN
@@ -207,15 +303,234 @@ class WatershedTask(BlockTask):
             log_fn(f"processed block {block_id}")
 
 
+class WatershedPass1Task(WatershedTask):
+    """Checkerboard color-0 blocks, plain pipeline (two_pass_watershed pass 0)."""
+
+    task_name = "watershed_pass1"
+    pass_id = 0
+
+
+class WatershedPass2Task(WatershedTask):
+    """Checkerboard color-1 blocks, seeded by the pass-1 labels in the halo
+    (reference: two_pass_watershed.py:210-255)."""
+
+    task_name = "watershed_pass2"
+    pass_id = 1
+    seeded = True
+
+
+class WatershedFromSeedsTask(BlockTask):
+    """Blockwise seeded watershed from a precomputed seed volume (reference:
+    watershed_from_seeds.py:25 — grow given seeds over the boundary map; no
+    new seeds, no offsets: seed ids are already globally consistent)."""
+
+    task_name = "watershed_from_seeds"
+
+    def __init__(self, input_path: str, input_key: str, seeds_path: str,
+                 seeds_key: str, output_path: str, output_key: str,
+                 mask_path: str = "", mask_key: str = "", **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.seeds_path = seeds_path
+        self.seeds_key = seeds_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.mask_path = mask_path
+        self.mask_key = mask_key
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"halo": [2, 16, 16], "sigma_weights": 2.0,
+                     "invert_inputs": False, "agglomerate_channels": "mean",
+                     "channel_begin": 0, "channel_end": None})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            in_shape = f[self.input_key].shape
+        shape = list(in_shape[1:] if len(in_shape) == 4 else in_shape)
+        block_shape = self.global_block_shape()[-len(shape):]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=shape, chunks=block_shape,
+                              dtype="uint64")
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "seeds_path": self.seeds_path, "seeds_key": self.seeds_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "mask_path": self.mask_path, "mask_key": self.mask_key,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        import jax.numpy as jnp
+
+        from ..ops.filters import gaussian
+        from ..ops.watershed import seeded_watershed
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        halo = (cfg.get("halo") or [0] * blocking.ndim)[-blocking.ndim:]
+        f_in = file_reader(cfg["input_path"], "r")
+        f_seeds = file_reader(cfg["seeds_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in = f_in[cfg["input_key"]]
+        ds_seeds = f_seeds[cfg["seeds_key"]]
+        ds_out = f_out[cfg["output_key"]]
+        mask = None
+        if cfg.get("mask_path"):
+            from ..core.volume_views import load_mask
+
+            mask = load_mask(cfg["mask_path"], cfg["mask_key"], cfg["shape"])
+
+        sigma = cfg.get("sigma_weights", 2.0)
+        for block_id in job_config["block_list"]:
+            bh = blocking.get_block_with_halo(block_id, halo)
+            data = _read_input(ds_in, bh.outer.bb, cfg)
+            bmask = None
+            if mask is not None:
+                bmask = np.asarray(mask[bh.outer.bb]) > 0
+                if not bmask.any():
+                    log_fn(f"processed block {block_id}")
+                    continue
+            seeds = np.asarray(ds_seeds[bh.outer.bb])
+            # densify (seed ids are arbitrary uint64; device wants int32)
+            from ..ops.rag import densify_labels
+
+            lut, dense = densify_labels(seeds)
+            if len(lut) == 1:  # only the reserved 0 entry: no seeds here
+                log_fn(f"processed block {block_id}")
+                continue
+            height = gaussian(jnp.asarray(data), sigma) if sigma else \
+                jnp.asarray(data)
+            ws = np.asarray(seeded_watershed(
+                height, jnp.asarray(dense),
+                None if bmask is None else jnp.asarray(bmask),
+                connectivity=1))
+            out = lut[ws]
+            ds_out[bh.inner.bb] = out[bh.inner_local.bb]
+            log_fn(f"processed block {block_id}")
+
+
+class AgglomerateTask(BlockTask):
+    """Block-local RAG agglomeration of watershed fragments (reference:
+    watershed/agglomerate.py:129+ — gridRag + accumulateEdgeMeanAndLength +
+    mala/edge-weighted agglo policy + projectScalarNodeDataToPixels).
+
+    TPU split: edge extraction + per-edge mean boundary evidence run on
+    device (ops/rag), the priority-queue agglomeration in first-party C++
+    (native.agglomerative_clustering).  Fragment ids are re-offset per block
+    (the workflow relabels afterwards, as in the reference)."""
+
+    task_name = "agglomerate"
+
+    def __init__(self, input_path: str, input_key: str, labels_path: str,
+                 labels_key: str, output_path: str, output_key: str, **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.labels_path = labels_path
+        self.labels_key = labels_key
+        self.output_path = output_path
+        self.output_key = output_key
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"threshold": 0.9, "size_regularizer": 0.5,
+                     "invert_inputs": False, "agglomerate_channels": "mean",
+                     "channel_begin": 0, "channel_end": None})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.labels_path, "r") as f:
+            shape = list(f[self.labels_key].shape)
+        block_shape = self.global_block_shape()[-len(shape):]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=shape, chunks=block_shape,
+                              dtype="uint64")
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "labels_path": self.labels_path, "labels_key": self.labels_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        import jax.numpy as jnp
+
+        from .. import native
+        from ..ops.rag import boundary_pair_values, densify_labels
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        f_in = file_reader(cfg["input_path"], "r")
+        f_lab = file_reader(cfg["labels_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in = f_in[cfg["input_key"]]
+        ds_lab = f_lab[cfg["labels_key"]]
+        ds_out = f_out[cfg["output_key"]]
+        threshold = cfg.get("threshold", 0.9)
+        size_reg = cfg.get("size_regularizer", 0.5)
+        offset_unit = np.uint64(np.prod(cfg["block_shape"]))
+
+        for block_id in job_config["block_list"]:
+            block = blocking.get_block(block_id)
+            labels = np.asarray(ds_lab[block.bb])
+            lut, dense = densify_labels(labels)
+            n_nodes = len(lut)
+            if n_nodes <= 1:
+                ds_out[block.bb] = labels
+                log_fn(f"processed block {block_id}")
+                continue
+            bmap = _read_input(ds_in, block.bb, cfg)
+            u, v, val, ok = boundary_pair_values(
+                jnp.asarray(dense), jnp.asarray(bmap))
+            m = np.asarray(ok)
+            uv_all = np.stack([np.asarray(u)[m], np.asarray(v)[m]], axis=1)
+            vals = np.asarray(val)[m].astype("float64")
+            if len(uv_all) == 0:
+                ds_out[block.bb] = labels
+                log_fn(f"processed block {block_id}")
+                continue
+            # per-(dense) edge mean + size; drop edges to the ignore label 0
+            uv, inv = np.unique(uv_all, axis=0, return_inverse=True)
+            sums = np.bincount(inv, weights=vals, minlength=len(uv))
+            sizes = np.bincount(inv, minlength=len(uv)).astype("float64")
+            keep = (uv[:, 0] != 0) & (uv[:, 1] != 0)
+            uv, sums, sizes = uv[keep], sums[keep], sizes[keep]
+            node_sizes = np.bincount(dense.ravel(),
+                                     minlength=n_nodes).astype("float64")
+            clusters = native.agglomerative_clustering(
+                n_nodes, uv, sums / np.maximum(sizes, 1), edge_sizes=sizes,
+                node_sizes=node_sizes, threshold=threshold,
+                size_regularizer=size_reg)
+            # keep 0 as background, compact cluster ids, offset per block
+            clusters = clusters.astype("uint64")
+            nz = np.unique(clusters[1:]) if n_nodes > 1 else clusters
+            remap = np.searchsorted(nz, clusters).astype("uint64") + 1
+            remap[0] = 0
+            out = remap[dense] + np.where(remap[dense] > 0,
+                                          np.uint64(block_id) * offset_unit,
+                                          np.uint64(0))
+            ds_out[block.bb] = out
+            log_fn(f"processed block {block_id}")
+
+
 class WatershedWorkflow(Task):
-    """Watershed -> RelabelWorkflow (reference:
-    watershed/watershed_workflow.py:20-60; agglomeration step arrives with the
-    graph stack)."""
+    """[TwoPass]Watershed -> [Agglomerate] -> RelabelWorkflow (reference:
+    watershed/watershed_workflow.py:20-60)."""
 
     def __init__(self, input_path: str, input_key: str, output_path: str,
                  output_key: str, tmp_folder: str, config_dir: str,
                  max_jobs: int = 1, target: str = "local",
                  mask_path: str = "", mask_key: str = "",
+                 two_pass: bool = False, agglomeration: bool = False,
                  dependency: Optional[Task] = None):
         self.input_path = input_path
         self.input_key = input_key
@@ -223,6 +538,13 @@ class WatershedWorkflow(Task):
         self.output_key = output_key
         self.mask_path = mask_path
         self.mask_key = mask_key
+        if two_pass and agglomeration:
+            raise ValueError(
+                "two_pass and agglomeration are mutually exclusive: the "
+                "block-local agglomerate re-offsets ids per block, splitting "
+                "every segment the seeded pass-2 stitched across faces")
+        self.two_pass = two_pass
+        self.agglomeration = agglomeration
         self.tmp_folder = tmp_folder
         self.config_dir = config_dir
         self.max_jobs = max_jobs
@@ -233,14 +555,31 @@ class WatershedWorkflow(Task):
     def requires(self):
         common = dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
                       max_jobs=self.max_jobs, target=self.target)
-        ws = WatershedTask(
+        ws_kwargs = dict(
             input_path=self.input_path, input_key=self.input_key,
             output_path=self.output_path, output_key=self.output_key,
-            mask_path=self.mask_path, mask_key=self.mask_key,
-            dependency=self.dependency, **common)
+            mask_path=self.mask_path, mask_key=self.mask_key)
+        if self.two_pass:
+            p1 = WatershedPass1Task(dependency=self.dependency, **ws_kwargs,
+                                    **common)
+            dep: Task = WatershedPass2Task(dependency=p1, **ws_kwargs,
+                                           **common)
+        else:
+            dep = WatershedTask(dependency=self.dependency, **ws_kwargs,
+                                **common)
+        if self.agglomeration:
+            # in-place: block-local transform, each block reads and rewrites
+            # only its own chunk-aligned region (single-writer invariant
+            # holds; reference chains a separate agglomerate dataset,
+            # agglomerate.py:129+, but the copy buys nothing here)
+            dep = AgglomerateTask(
+                input_path=self.input_path, input_key=self.input_key,
+                labels_path=self.output_path, labels_key=self.output_key,
+                output_path=self.output_path, output_key=self.output_key,
+                dependency=dep, **common)
         return RelabelWorkflow(
             input_path=self.output_path, input_key=self.output_key,
-            identifier="relabel_ws", dependency=ws, **common)
+            identifier="relabel_ws", dependency=dep, **common)
 
     def output(self):
         from ..core.workflow import FileTarget

@@ -1,0 +1,243 @@
+"""Stitching + watershed-variant tests.
+
+Oracle styles (SURVEY §4): property checks (labels continuous across block
+boundaries after stitching) and recompute-in-numpy oracles for the face
+matching rule."""
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.core.storage import file_reader
+from cluster_tools_tpu.core.workflow import build
+
+
+def _split_label_volume(shape, block_shape, n_cells=4, seed=0):
+    """Ground-truth cells, then re-label per block (the unstitched state:
+    every block uses its own ids)."""
+    rng = np.random.RandomState(seed)
+    points = rng.rand(n_cells, len(shape)) * np.array(shape)
+    grids = np.meshgrid(*[np.arange(s) for s in shape], indexing="ij")
+    coords = np.stack([g.ravel() for g in grids], axis=1)
+    d = np.linalg.norm(coords[:, None, :] - points[None, :, :], axis=2)
+    truth = (d.argmin(axis=1) + 1).reshape(shape).astype("uint64")
+
+    from cluster_tools_tpu.core.blocking import Blocking
+
+    blocking = Blocking(list(shape), list(block_shape))
+    split = np.zeros(shape, "uint64")
+    offset = 0
+    for bid in range(blocking.n_blocks):
+        bb = blocking.get_block(bid).bb
+        sub = truth[bb]
+        uniq = np.unique(sub)
+        split[bb] = np.searchsorted(uniq, sub) + 1 + offset
+        offset += len(uniq)
+    return truth, split
+
+
+def test_match_face_segments_mutual_max():
+    from cluster_tools_tpu.workflows.stitching import match_face_segments
+
+    # plane A has segments 1, 2; plane B has 10 (matches 1), 11 (matches 2)
+    a = np.array([[1, 1, 1, 2, 2, 2]], "uint64")
+    b = np.array([[10, 10, 10, 11, 11, 11]], "uint64")
+    pairs = match_face_segments(a, b, overlap_threshold=0.5)
+    assert sorted(map(tuple, pairs.tolist())) == [(1, 10), (2, 11)]
+
+    # non-mutual: b=10 overlaps a=1 most, but a=1's best partner is 11
+    a = np.array([[1, 1, 1, 1, 1, 2]], "uint64")
+    b = np.array([[10, 11, 11, 11, 11, 11]], "uint64")
+    pairs = match_face_segments(a, b, overlap_threshold=0.3)
+    assert (1, 11) in set(map(tuple, pairs.tolist()))
+    assert (1, 10) not in set(map(tuple, pairs.tolist()))
+
+    # below threshold: mutual but weak overlap is rejected
+    a = np.array([[1, 1, 2, 2]], "uint64")
+    b = np.array([[10, 11, 11, 12]], "uint64")
+    pairs = match_face_segments(a, b, overlap_threshold=0.9)
+    assert len(pairs) == 0
+
+
+def test_stitching_workflow_recovers_truth(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.stitching import StitchingWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    shape, block_shape = (20, 20, 20), (10, 10, 10)
+    truth, split = _split_label_volume(shape, block_shape, n_cells=4)
+
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        ds = f.create_dataset("split", data=split, chunks=block_shape)
+        ds.attrs["maxId"] = int(split.max())
+
+    from cluster_tools_tpu.core.config import ConfigDir
+
+    ConfigDir(config_dir).write_task_config(
+        "stitch_faces", {"overlap_threshold": 0.5})
+    wf = StitchingWorkflow(
+        labels_path=path, labels_key="split",
+        output_path=path, output_key="stitched",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        stitched = f["stitched"][:]
+    # no false merges: every stitched id covers exactly one truth cell
+    for sid in np.unique(stitched):
+        assert len(np.unique(truth[stitched == sid])) == 1
+    # near-perfect recovery — only voxel-sliver fragments may stay split
+    # (they lose the mutual-max competition, as in the reference's
+    # overlap-threshold design)
+    from cluster_tools_tpu.utils.validation import rand_index
+
+    are, _ = rand_index(stitched, truth)
+    assert are < 0.05
+    assert len(np.unique(stitched)) <= len(np.unique(split)) / 2
+
+
+def test_simple_stitching_merges_boundary_edges(tmp_workdir, tmp_path):
+    """Full problem-based stitching: graph from the split volume, edge
+    features, then merge every block-boundary edge."""
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.workflows.graph import GraphWorkflow
+    from cluster_tools_tpu.workflows.features import EdgeFeaturesWorkflow
+    from cluster_tools_tpu.workflows.stitching import (
+        StitchingAssignmentsWorkflow)
+
+    tmp_folder, config_dir = tmp_workdir
+    shape, block_shape = (20, 20, 20), (10, 10, 10)
+    truth, split = _split_label_volume(shape, block_shape, n_cells=3, seed=5)
+    # relabel consecutively (graph stack wants dense-ish ids)
+    uniq = np.unique(split)
+    split = np.searchsorted(uniq, split).astype("uint64") + 1
+
+    path = str(tmp_path / "data.n5")
+    problem = str(tmp_path / "problem.n5")
+    bmap = np.zeros(shape, "float32")  # flat boundary evidence
+    with file_reader(path) as f:
+        f.create_dataset("labels", data=split, chunks=block_shape)
+        f.create_dataset("boundaries", data=bmap, chunks=block_shape)
+
+    common = dict(tmp_folder=tmp_folder, config_dir=config_dir,
+                  max_jobs=2, target="threads")
+    graph = GraphWorkflow(
+        input_path=path, input_key="labels", graph_path=problem,
+        output_key="s0/graph", **common)
+    feats = EdgeFeaturesWorkflow(
+        input_path=path, input_key="boundaries",
+        labels_path=path, labels_key="labels",
+        graph_path=problem, output_path=problem,
+        graph_key="s0/graph", dependency=graph, **common)
+    stitch = StitchingAssignmentsWorkflow(
+        problem_path=problem, labels_path=path, labels_key="labels",
+        assignments_path=problem, assignments_key="stitch_assignments",
+        graph_key="s0/graph", features_key="features",
+        edge_size_threshold=0, dependency=feats, **common)
+    assert ctt.build([stitch], raise_on_failure=True)
+
+    with file_reader(problem, "r") as f:
+        assignments = f["stitch_assignments"][:]
+    merged = assignments[split]
+    # merging ALL boundary edges glues every face-adjacent fragment pair:
+    # cells touching across faces also merge, so just check that fragments
+    # of the same truth cell ended up together (no splits)
+    for cell in np.unique(truth):
+        assert len(np.unique(merged[truth == cell])) == 1
+
+
+def test_two_pass_watershed(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+    from tests.test_watershed import _boundary_volume
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (20, 20, 20)
+    vol = _boundary_volume(shape, n_cells=4)
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.create_dataset("boundaries", data=vol, chunks=(10, 10, 10))
+
+    wf = WatershedWorkflow(
+        input_path=path, input_key="boundaries",
+        output_path=path, output_key="ws",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="inline", two_pass=True)
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        ws = f["ws"][:]
+    assert (ws > 0).all()
+    uniques = np.unique(ws)
+    assert uniques[0] == 1 and uniques[-1] == len(uniques)
+    # two-pass should stitch across the checkerboard: fragment count closer
+    # to the single-pass-with-relabel count but labels must still cover all
+    # 8 blocks; sanity-bound it
+    assert 2 <= len(uniques) < 300
+
+
+def test_watershed_from_seeds(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.watershed import WatershedFromSeedsTask
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 16, 16)
+    vol = np.zeros(shape, "float32")
+    vol[:, 7:9, :] = 1.0  # ridge splitting y<7 from y>=9
+    seeds = np.zeros(shape, "uint64")
+    seeds[8, 2, 8] = 7
+    seeds[8, 13, 8] = 42
+
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.create_dataset("boundaries", data=vol, chunks=(8, 8, 8))
+        f.create_dataset("seeds", data=seeds, chunks=(8, 8, 8))
+
+    task = WatershedFromSeedsTask(
+        input_path=path, input_key="boundaries",
+        seeds_path=path, seeds_key="seeds",
+        output_path=path, output_key="ws",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="inline")
+    assert build([task], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        ws = f["ws"][:]
+    # seed ids are preserved and grown to fill their basins
+    assert set(np.unique(ws)) <= {0, 7, 42}
+    assert (ws[:, :7, :] == 7).all()
+    assert (ws[:, 9:, :] == 42).all()
+
+
+def test_agglomerate_task_reduces_fragments(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+    from tests.test_watershed import _boundary_volume
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (20, 20, 20)
+    vol = _boundary_volume(shape, n_cells=4)
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.create_dataset("boundaries", data=vol, chunks=(10, 10, 10))
+
+    # plain workflow
+    wf = WatershedWorkflow(
+        input_path=path, input_key="boundaries",
+        output_path=path, output_key="ws_plain",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="inline")
+    assert build([wf], raise_on_failure=True)
+    # with block-local agglomeration (merge everything below high threshold)
+    tmp2 = tmp_folder + "_agglo"
+    wf = WatershedWorkflow(
+        input_path=path, input_key="boundaries",
+        output_path=path, output_key="ws_agglo",
+        tmp_folder=tmp2, config_dir=config_dir,
+        max_jobs=1, target="inline", agglomeration=True)
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        plain = f["ws_plain"][:]
+        agglo = f["ws_agglo"][:]
+    assert (agglo > 0).all()
+    n_plain = len(np.unique(plain))
+    n_agglo = len(np.unique(agglo))
+    assert n_agglo <= n_plain
