@@ -4,6 +4,10 @@ from .graph import GraphWorkflow
 from .inference import InferenceTask
 from .multicut import MulticutWorkflow
 from .mutex_watershed import MwsWorkflow, TwoPassMwsWorkflow
+from .postprocess import (ConnectedComponentsWorkflow, FilterLabelsWorkflow,
+                          FilterOrphansWorkflow,
+                          SizeFilterAndGraphWatershedWorkflow,
+                          SizeFilterWorkflow)
 from .relabel import RelabelWorkflow
 from .segmentation import MulticutSegmentationWorkflow, ProblemWorkflow
 from .stitching import StitchingAssignmentsWorkflow, StitchingWorkflow
@@ -12,8 +16,10 @@ from .watershed import (AgglomerateTask, WatershedFromSeedsTask,
                         WatershedWorkflow)
 
 __all__ = [
-    "AgglomerateTask", "GraphWorkflow", "InferenceTask", "MulticutWorkflow",
-    "MwsWorkflow", "TwoPassMwsWorkflow",
+    "AgglomerateTask", "ConnectedComponentsWorkflow", "FilterLabelsWorkflow",
+    "FilterOrphansWorkflow", "GraphWorkflow", "InferenceTask",
+    "MulticutWorkflow", "MwsWorkflow", "TwoPassMwsWorkflow",
+    "SizeFilterAndGraphWatershedWorkflow", "SizeFilterWorkflow",
     "RelabelWorkflow", "MulticutSegmentationWorkflow", "ProblemWorkflow",
     "StitchingAssignmentsWorkflow", "StitchingWorkflow",
     "ThresholdedComponentsWorkflow", "WatershedFromSeedsTask",

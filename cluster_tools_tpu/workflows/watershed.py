@@ -132,8 +132,14 @@ def run_ws_block_seeded(data: np.ndarray, cfg: Dict[str, Any],
     from ..ops.components import connected_components
     from ..ops.edt import distance_transform_edt
     from ..ops.filters import gaussian, local_maxima
+    from ..ops.rag import densify_labels
     from ..ops.watershed import seeded_watershed
 
+    if cfg.get("apply_dt_2d") or cfg.get("apply_ws_2d"):
+        raise ValueError(
+            "two-pass watershed supports 3d only: per-slice 2d watershed "
+            "cannot continue seeds across slices — disable apply_dt_2d/"
+            "apply_ws_2d or use the single-pass task")
     threshold = cfg.get("threshold", 0.25)
     sigma_seeds = cfg.get("sigma_seeds", 2.0)
     sigma_weights = cfg.get("sigma_weights", 2.0)
@@ -152,8 +158,6 @@ def run_ws_block_seeded(data: np.ndarray, cfg: Dict[str, Any],
     height = alpha * hmap + (1.0 - alpha) * (1.0 - dt / dmax)
 
     # densify initial seeds to 1..k for the device program (lut[0] == 0)
-    from ..ops.rag import densify_labels
-
     lut, dense_init = densify_labels(initial_seeds)
     k = len(lut) - 1
 
@@ -179,6 +183,22 @@ def run_ws_block_seeded(data: np.ndarray, cfg: Dict[str, Any],
                 f"{cfg['id_budget']} — labels would collide across blocks")
         out[new_part] = (np.searchsorted(new_ids, ws[new_part])
                          .astype("uint64") + np.uint64(label_offset) + 1)
+
+    # size-filter NEW fragments only (continued seeds are protected — they
+    # are partial views of segments that extend beyond this block), then
+    # regrow the survivors: keeps pass-1/pass-2 fragment statistics aligned
+    # (run_ws_block applies the same filter to all fragments)
+    min_size = cfg.get("size_filter", 0)
+    if min_size and new_part.any():
+        ids, sizes = np.unique(out[new_part], return_counts=True)
+        small = ids[sizes < min_size]
+        if len(small):
+            drop = np.isin(out, small)
+            out[drop] = 0
+            lut2, dense2 = densify_labels(out)
+            regrown = np.asarray(seeded_watershed(
+                height, jnp.asarray(dense2), jmask, connectivity=1))
+            out = lut2[regrown]
     return out
 
 

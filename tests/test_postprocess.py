@@ -1,0 +1,267 @@
+"""Postprocess suite tests: numpy-oracle checks for each filter and graph
+step (reference capability: postprocess_workflow.py:24-420)."""
+
+import json
+import os
+
+import numpy as np
+
+from cluster_tools_tpu.core.storage import file_reader
+from cluster_tools_tpu.core.workflow import build
+
+
+def _seg_volume(shape=(16, 16, 16)):
+    """Labels 1..4 as axis-aligned slabs + one tiny segment 5."""
+    seg = np.zeros(shape, "uint64")
+    seg[:4] = 1
+    seg[4:8] = 2
+    seg[8:12] = 3
+    seg[12:] = 4
+    seg[0, 0, 0:3] = 5  # 3-voxel sliver inside segment 1
+    return seg
+
+
+def test_size_filter_background(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.postprocess import SizeFilterWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    seg = _seg_volume()
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+
+    wf = SizeFilterWorkflow(
+        input_path=path, input_key="seg", output_path=path,
+        output_key="filtered", size_threshold=10,
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads", relabel=False)
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        out = f["filtered"][:]
+    # sliver 5 went to background, others survive untouched
+    assert (out[seg == 5] == 0).all()
+    for lbl in (1, 2, 3, 4):
+        assert (out[(seg == lbl)] == lbl).all()
+
+
+def test_size_filter_filling(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.postprocess import SizeFilterWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    seg = _seg_volume()
+    hmap = np.zeros(seg.shape, "float32")
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        f.create_dataset("hmap", data=hmap, chunks=[8, 8, 8])
+
+    wf = SizeFilterWorkflow(
+        input_path=path, input_key="seg", output_path=path,
+        output_key="filled", size_threshold=10,
+        hmap_path=path, hmap_key="hmap",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads", relabel=False)
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        out = f["filled"][:]
+    # sliver voxels were regrown into the surrounding segment 1 — no holes
+    assert (out[seg == 5] == 1).all()
+    assert (out > 0).all()
+
+
+def test_filter_labels_workflow(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.postprocess import FilterLabelsWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    seg = _seg_volume()
+    # semantic map: label 9 over segments 1/2, label 7 over 3/4
+    sem = np.where(np.arange(16)[:, None, None] < 8, 9, 7) * np.ones(
+        seg.shape, "uint64")
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = int(seg.max())
+        f.create_dataset("sem", data=sem.astype("uint64"), chunks=[8, 8, 8])
+
+    wf = FilterLabelsWorkflow(
+        input_path=path, input_key="seg", label_path=path, label_key="sem",
+        node_label_path=path, node_label_key="node_labels",
+        output_path=path, output_key="filtered", filter_labels=[9],
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        out = f["filtered"][:]
+    # segments under semantic label 9 (= 1, 2, 5) are gone; 3, 4 remain
+    assert set(np.unique(out)) == {0, 3, 4}
+
+
+def test_graph_watershed_assignments(tmp_workdir, tmp_path):
+    """Discarded small segment is reassigned to its strongest neighbor."""
+    from cluster_tools_tpu.core.graph import save_graph
+    from cluster_tools_tpu.workflows.postprocess import (
+        GraphWatershedAssignments)
+
+    tmp_folder, config_dir = tmp_workdir
+    problem = str(tmp_path / "p.n5")
+    # nodes 0..4; assignments: node i -> segment i (0 = background)
+    # node 3 (small segment) connects to segment 1 (weak boundary, 0.1)
+    # and segment 2 (strong boundary, 0.9) -> should join segment 1
+    uv = np.array([[1, 3], [2, 3], [1, 2], [0, 4]], "uint64")
+    feats = np.zeros((4, 10), "float64")
+    feats[:, 0] = [0.1, 0.9, 0.8, 0.5]
+    save_graph(problem, "graph", np.arange(5, dtype="uint64"), uv, (1, 1, 1))
+    with file_reader(problem) as f:
+        f.create_dataset("features", data=feats)
+        f.create_dataset("assignments",
+                         data=np.arange(5, dtype="uint64"))
+    discard_path = str(tmp_path / "discard.npy")
+    np.save(discard_path, np.array([3], "uint64"))
+
+    task = GraphWatershedAssignments(
+        problem_path=problem, graph_key="graph", features_key="features",
+        assignment_path=problem, assignment_key="assignments",
+        output_path=problem, output_key="new_assignments",
+        filter_nodes_path=discard_path,
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([task], raise_on_failure=True)
+
+    with file_reader(problem, "r") as f:
+        out = f["new_assignments"][:]
+    assert out[3] == 1  # joined via the weakest boundary
+    assert out[0] == 0  # background preserved
+    assert out[1] == 1 and out[2] == 2 and out[4] == 4
+
+
+def test_orphan_assignments(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.core.graph import save_graph
+    from cluster_tools_tpu.workflows.postprocess import OrphanAssignments
+
+    tmp_folder, config_dir = tmp_workdir
+    problem = str(tmp_path / "p.n5")
+    # segment graph: 1-2, 2-3, 3-1 triangle; 4 hangs off 2 (orphan)
+    # node i -> segment assignments
+    uv = np.array([[0, 1], [1, 2], [2, 0], [1, 3]], "uint64")
+    assignments = np.array([1, 2, 3, 4], "uint64")
+    save_graph(problem, "graph", np.arange(4, dtype="uint64"), uv, (1, 1, 1))
+    with file_reader(problem) as f:
+        f.create_dataset("assignments", data=assignments)
+
+    task = OrphanAssignments(
+        graph_path=problem, graph_key="graph",
+        assignment_path=problem, assignment_key="assignments",
+        output_path=problem, output_key="out",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([task], raise_on_failure=True)
+
+    with file_reader(problem, "r") as f:
+        out = f["out"][:]
+    # orphan segment 4 merged into its only neighbor (2)
+    np.testing.assert_array_equal(out, [1, 2, 3, 2])
+
+
+def test_graph_connected_components(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.core.graph import save_graph
+    from cluster_tools_tpu.workflows.postprocess import (
+        ConnectedComponentsWorkflow)
+
+    tmp_folder, config_dir = tmp_workdir
+    problem = str(tmp_path / "p.n5")
+    # nodes 0-1 connected, 2-3 connected, but no edge between the pairs;
+    # all four share assignment 1 -> must split into two components
+    uv = np.array([[0, 1], [2, 3]], "uint64")
+    assignments = np.array([1, 1, 1, 1], "uint64")
+    save_graph(problem, "graph", np.arange(4, dtype="uint64"), uv, (1, 1, 1))
+    with file_reader(problem) as f:
+        f.create_dataset("assignments", data=assignments)
+
+    wf = ConnectedComponentsWorkflow(
+        problem_path=problem, graph_key="graph",
+        assignment_path=problem, assignment_key="assignments",
+        output_path=problem, assignment_out_key="cc",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(problem, "r") as f:
+        out = f["cc"][:]
+    assert out[0] == out[1]
+    assert out[2] == out[3]
+    assert out[0] != out[2]
+    # no segment may be erased to background (root-0 components included)
+    assert (out != 0).all()
+
+
+def test_orphan_assignments_mutual_pair(tmp_workdir, tmp_path):
+    """Two segments whose only edge is to each other merge (not swap)."""
+    from cluster_tools_tpu.core.graph import save_graph
+    from cluster_tools_tpu.workflows.postprocess import OrphanAssignments
+
+    tmp_folder, config_dir = tmp_workdir
+    problem = str(tmp_path / "p.n5")
+    # nodes 0,1 -> segments 1,2 with a single connecting edge
+    uv = np.array([[0, 1]], "uint64")
+    save_graph(problem, "graph", np.arange(2, dtype="uint64"), uv, (1, 1, 1))
+    with file_reader(problem) as f:
+        f.create_dataset("assignments", data=np.array([1, 2], "uint64"))
+
+    task = OrphanAssignments(
+        graph_path=problem, graph_key="graph",
+        assignment_path=problem, assignment_key="assignments",
+        output_path=problem, output_key="out",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([task], raise_on_failure=True)
+    with file_reader(problem, "r") as f:
+        out = f["out"][:]
+    np.testing.assert_array_equal(out, [1, 1])
+
+
+def test_size_filter_and_graph_watershed_workflow(tmp_workdir, tmp_path):
+    """End-to-end: tiny segment detected by size and re-assigned by graph
+    watershed, then written back to the volume."""
+    from cluster_tools_tpu.core.graph import save_graph
+    from cluster_tools_tpu.workflows.postprocess import (
+        SizeFilterAndGraphWatershedWorkflow)
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (8, 8, 8)
+    # fragments: 1 fills the left half, 2 the right half, 3 = tiny corner
+    frag = np.zeros(shape, "uint64")
+    frag[:, :4, :] = 1
+    frag[:, 4:, :] = 2
+    frag[0, 0, 0] = 3
+    # segmentation = identity assignment
+    path = str(tmp_path / "d.n5")
+    problem = str(tmp_path / "p.n5")
+    with file_reader(path) as f:
+        f.create_dataset("frags", data=frag, chunks=[8, 8, 8])
+        f.create_dataset("seg", data=frag, chunks=[8, 8, 8])
+        # the reference keeps segmentation and assignment table in the same
+        # container (`path`); mirror that layout
+        f.create_dataset("assignments", data=np.arange(4, dtype="uint64"))
+    uv = np.array([[1, 2], [1, 3], [2, 3]], "uint64")
+    feats = np.zeros((3, 10), "float64")
+    feats[:, 0] = [0.9, 0.1, 0.8]  # 3 joins 1
+    save_graph(problem, "graph", np.arange(4, dtype="uint64"), uv, shape)
+    with file_reader(problem) as f:
+        f.create_dataset("features", data=feats)
+
+    wf = SizeFilterAndGraphWatershedWorkflow(
+        problem_path=problem, graph_key="graph", features_key="features",
+        path=path, segmentation_key="seg", assignment_key="assignments",
+        size_threshold=5, output_path=problem,
+        assignment_out_key="new_assignments",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(problem, "r") as f:
+        out = f["new_assignments"][:]
+    assert out[3] == 1  # tiny segment re-assigned across weakest boundary
+    assert out[1] == 1 and out[2] == 2
