@@ -15,8 +15,15 @@ from .core.workflow import Task, DummyTask, build
 from .core.runtime import BlockTask, FailedJobsError
 from .core.blocking import Blocking, blocks_in_volume, block_to_bb
 from .core.storage import file_reader
+# top-level workflow re-exports (reference: cluster_tools/__init__.py:1-9)
+from .workflows import (AgglomerativeClusteringWorkflow,
+                        LiftedMulticutSegmentationWorkflow,
+                        MulticutSegmentationWorkflow, MwsWorkflow,
+                        SimpleStitchingWorkflow)
 
 __all__ = [
     "Task", "DummyTask", "build", "BlockTask", "FailedJobsError",
     "Blocking", "blocks_in_volume", "block_to_bb", "file_reader",
+    "AgglomerativeClusteringWorkflow", "LiftedMulticutSegmentationWorkflow",
+    "MulticutSegmentationWorkflow", "MwsWorkflow", "SimpleStitchingWorkflow",
 ]

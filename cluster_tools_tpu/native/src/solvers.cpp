@@ -290,6 +290,186 @@ int64_t mws_clustering(int64_t n_nodes, int64_t n_attr, const int64_t* uv_attr,
 }
 
 // ---------------------------------------------------------------------------
+// lifted multicut (nifty.graph.opt.lifted_multicut replacement,
+// reference: utils/segmentation_utils.py:153-223)
+// ---------------------------------------------------------------------------
+// Greedy additive contraction for the lifted objective: only LOCAL edges are
+// contractible (components must stay connected in the local graph), but the
+// contraction priority of a local pair includes the accumulated LIFTED cost
+// between the two components.
+int64_t lmc_gaec(int64_t n_nodes, int64_t n_local, const int64_t* uv_local,
+                 const double* costs_local, int64_t n_lifted,
+                 const int64_t* uv_lifted, const double* costs_lifted,
+                 uint64_t* labels_out) {
+    std::vector<std::unordered_map<int64_t, double>> adj(n_nodes);   // local
+    std::vector<std::unordered_map<int64_t, double>> lift(n_nodes);  // lifted
+    for (int64_t i = 0; i < n_local; ++i) {
+        int64_t u = uv_local[2 * i], v = uv_local[2 * i + 1];
+        if (u == v) continue;
+        adj[u][v] += costs_local[i];
+        adj[v][u] += costs_local[i];
+    }
+    for (int64_t i = 0; i < n_lifted; ++i) {
+        int64_t u = uv_lifted[2 * i], v = uv_lifted[2 * i + 1];
+        if (u == v) continue;
+        lift[u][v] += costs_lifted[i];
+        lift[v][u] += costs_lifted[i];
+    }
+    auto pair_w = [&](int64_t ru, int64_t rv) {
+        double w = 0.0;
+        auto it = adj[ru].find(rv);
+        if (it != adj[ru].end()) w += it->second;
+        auto jt = lift[ru].find(rv);
+        if (jt != lift[ru].end()) w += jt->second;
+        return w;
+    };
+    using Entry = std::tuple<double, int64_t, int64_t>;
+    std::priority_queue<Entry> pq;
+    for (int64_t u = 0; u < n_nodes; ++u) {
+        for (const auto& kv : adj[u]) {
+            if (kv.first > u) {
+                double w = pair_w(u, kv.first);
+                if (w > 0) pq.emplace(w, u, kv.first);
+            }
+        }
+    }
+    Ufd ufd(n_nodes);
+    while (!pq.empty()) {
+        auto [w, u, v] = pq.top();
+        pq.pop();
+        if (w <= 0) break;
+        int64_t ru = ufd.find(u), rv = ufd.find(v);
+        if (ru == rv) continue;
+        if (adj[ru].find(rv) == adj[ru].end()) continue;  // no local edge
+        double live = pair_w(ru, rv);
+        if (live != w || u != std::min(ru, rv) || v != std::max(ru, rv)) {
+            if (live > 0) pq.emplace(live, std::min(ru, rv), std::max(ru, rv));
+            continue;
+        }
+        if (adj[ru].size() + lift[ru].size() <
+            adj[rv].size() + lift[rv].size()) {
+            std::swap(ru, rv);
+        }
+        int64_t rw = ufd.merge(ru, rv);
+        if (rw != ru) std::swap(ru, rv);
+        adj[ru].erase(rv);
+        adj[rv].erase(ru);
+        lift[ru].erase(rv);
+        lift[rv].erase(ru);
+        for (const auto& kv : adj[rv]) {
+            int64_t n = kv.first;
+            adj[n].erase(rv);
+            double& acc = adj[ru][n];
+            acc += kv.second;
+            adj[n][ru] = acc;
+        }
+        for (const auto& kv : lift[rv]) {
+            int64_t n = kv.first;
+            lift[n].erase(rv);
+            double& acc = lift[ru][n];
+            acc += kv.second;
+            lift[n][ru] = acc;
+        }
+        adj[rv].clear();
+        lift[rv].clear();
+        for (const auto& kv : adj[ru]) {  // refresh priorities of live pairs
+            double nw = pair_w(ru, kv.first);
+            if (nw > 0) {
+                pq.emplace(nw, std::min(ru, kv.first), std::max(ru, kv.first));
+            }
+        }
+    }
+    std::unordered_map<int64_t, uint64_t> remap;
+    uint64_t next = 0;
+    for (int64_t i = 0; i < n_nodes; ++i) {
+        int64_t r = ufd.find(i);
+        auto it = remap.find(r);
+        if (it == remap.end()) it = remap.emplace(r, next++).first;
+        labels_out[i] = it->second;
+    }
+    return static_cast<int64_t>(next);
+}
+
+// Kernighan-Lin-style refinement for the lifted objective: node moves among
+// LOCAL-neighbor components (or a fresh singleton), gains include lifted
+// contributions.
+int64_t lmc_kl_refine(int64_t n_nodes, int64_t n_local, const int64_t* uv_local,
+                      const double* costs_local, int64_t n_lifted,
+                      const int64_t* uv_lifted, const double* costs_lifted,
+                      uint64_t* labels, int64_t max_passes) {
+    auto build_csr = [n_nodes](int64_t n_e, const int64_t* uv, const double* c,
+                               std::vector<int64_t>& off,
+                               std::vector<int64_t>& nbr,
+                               std::vector<double>& nw) {
+        std::vector<int64_t> deg(n_nodes, 0);
+        for (int64_t i = 0; i < n_e; ++i) {
+            ++deg[uv[2 * i]];
+            ++deg[uv[2 * i + 1]];
+        }
+        off.assign(n_nodes + 1, 0);
+        for (int64_t i = 0; i < n_nodes; ++i) off[i + 1] = off[i] + deg[i];
+        nbr.resize(off[n_nodes]);
+        nw.resize(off[n_nodes]);
+        std::vector<int64_t> cur(off.begin(), off.end() - 1);
+        for (int64_t i = 0; i < n_e; ++i) {
+            int64_t u = uv[2 * i], v = uv[2 * i + 1];
+            nbr[cur[u]] = v;
+            nw[cur[u]++] = c[i];
+            nbr[cur[v]] = u;
+            nw[cur[v]++] = c[i];
+        }
+    };
+    std::vector<int64_t> loff, lnbr, toff, tnbr;
+    std::vector<double> lw, tw;
+    build_csr(n_local, uv_local, costs_local, loff, lnbr, lw);
+    build_csr(n_lifted, uv_lifted, costs_lifted, toff, tnbr, tw);
+
+    uint64_t next_label = 0;
+    for (int64_t i = 0; i < n_nodes; ++i) {
+        next_label = std::max(next_label, labels[i] + 1);
+    }
+    std::unordered_map<uint64_t, double> comp_w;
+    std::unordered_set<uint64_t> local_comps;
+    int64_t pass = 0;
+    for (; pass < max_passes; ++pass) {
+        bool improved = false;
+        for (int64_t x = 0; x < n_nodes; ++x) {
+            if (loff[x + 1] == loff[x]) continue;
+            comp_w.clear();
+            local_comps.clear();
+            for (int64_t j = loff[x]; j < loff[x + 1]; ++j) {
+                comp_w[labels[lnbr[j]]] += lw[j];
+                local_comps.insert(labels[lnbr[j]]);
+            }
+            for (int64_t j = toff[x]; j < toff[x + 1]; ++j) {
+                comp_w[labels[tnbr[j]]] += tw[j];
+            }
+            uint64_t own = labels[x];
+            double w_own = 0.0;
+            auto it_own = comp_w.find(own);
+            if (it_own != comp_w.end()) w_own = it_own->second;
+            double best_gain = -w_own;  // leave to a fresh singleton
+            uint64_t best_label = next_label;
+            for (uint64_t cand : local_comps) {
+                if (cand == own) continue;
+                double gain = comp_w[cand] - w_own;
+                if (gain > best_gain + 1e-12) {
+                    best_gain = gain;
+                    best_label = cand;
+                }
+            }
+            if (best_gain > 1e-12) {
+                labels[x] = best_label;
+                if (best_label == next_label) ++next_label;
+                improved = true;
+            }
+        }
+        if (!improved) break;
+    }
+    return pass;
+}
+
+// ---------------------------------------------------------------------------
 // edge-weighted agglomerative clustering
 // (nifty.graph.agglo edgeWeighted/mala cluster-policy replacement,
 // reference: utils/segmentation_utils.py:298-321, watershed/agglomerate.py)

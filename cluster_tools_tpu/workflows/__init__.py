@@ -8,17 +8,26 @@ from .postprocess import (ConnectedComponentsWorkflow, FilterLabelsWorkflow,
                           FilterOrphansWorkflow,
                           SizeFilterAndGraphWatershedWorkflow,
                           SizeFilterWorkflow)
+from .lifted_features import LiftedFeaturesFromNodeLabelsWorkflow
+from .lifted_multicut import LiftedMulticutWorkflow
 from .relabel import RelabelWorkflow
-from .segmentation import MulticutSegmentationWorkflow, ProblemWorkflow
+from .segmentation import (AgglomerativeClusteringWorkflow,
+                           LiftedMulticutSegmentationWorkflow,
+                           MulticutSegmentationWorkflow, ProblemWorkflow,
+                           SimpleStitchingWorkflow)
 from .stitching import StitchingAssignmentsWorkflow, StitchingWorkflow
 from .thresholded_components import ThresholdedComponentsWorkflow
 from .watershed import (AgglomerateTask, WatershedFromSeedsTask,
                         WatershedWorkflow)
 
 __all__ = [
-    "AgglomerateTask", "ConnectedComponentsWorkflow", "FilterLabelsWorkflow",
+    "AgglomerateTask", "AgglomerativeClusteringWorkflow",
+    "ConnectedComponentsWorkflow", "FilterLabelsWorkflow",
     "FilterOrphansWorkflow", "GraphWorkflow", "InferenceTask",
+    "LiftedFeaturesFromNodeLabelsWorkflow",
+    "LiftedMulticutSegmentationWorkflow", "LiftedMulticutWorkflow",
     "MulticutWorkflow", "MwsWorkflow", "TwoPassMwsWorkflow",
+    "SimpleStitchingWorkflow",
     "SizeFilterAndGraphWatershedWorkflow", "SizeFilterWorkflow",
     "RelabelWorkflow", "MulticutSegmentationWorkflow", "ProblemWorkflow",
     "StitchingAssignmentsWorkflow", "StitchingWorkflow",

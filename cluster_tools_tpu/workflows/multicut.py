@@ -57,6 +57,29 @@ def _sub_result_path(problem_path: str, scale: int, block_id: int) -> str:
                         f"block_{block_id}.npz")
 
 
+def compose_to_s0(problem_path: str, scale: int,
+                  labels: np.ndarray) -> np.ndarray:
+    """Map a scale-level node labeling back to s0 fragments through the
+    composed node_labeling (reference: solve_global.py node labeling)."""
+    if scale == 0:
+        return labels
+    with file_reader(problem_path, "r") as f:
+        initial = f[f"s{scale}/node_labeling"][:]
+    return labels[initial.astype("int64")]
+
+
+def save_assignment_table(nodes: np.ndarray, labels: np.ndarray,
+                          assignment_path: str) -> np.ndarray:
+    """Inflate per-node labels to a dense assignment table over
+    [0, max_label]; 0 and gaps stay background; segment ids start at 1."""
+    _, consecutive = np.unique(labels, return_inverse=True)
+    max_label = int(nodes.max()) if len(nodes) else 0
+    table = np.zeros(max_label + 1, dtype="uint64")
+    table[nodes.astype("int64")] = consecutive.astype("uint64") + 1
+    np.save(assignment_path, table)
+    return table
+
+
 class SolveSubproblems(BlockTask):
     """Per-block multicut over the scale's merged blocks (reference:
     SolveSubproblems, solve_subproblems.py:128-213)."""
@@ -75,6 +98,10 @@ class SolveSubproblems(BlockTask):
         conf.update({"agglomerator": "kernighan-lin", "time_limit_solver": None})
         return conf
 
+    def _extra_job_config(self) -> Dict[str, Any]:
+        """Hook: extra per-job config for subclasses (lifted)."""
+        return {}
+
     def run_impl(self):
         with file_reader(self.problem_path, "r") as f:
             shape = list(f[f"s0/graph"].attrs["shape"])
@@ -84,20 +111,41 @@ class SolveSubproblems(BlockTask):
         self.run_jobs(block_list, {
             "problem_path": self.problem_path, "scale": self.scale,
             "shape": shape, "block_shape": base_bs,
+            **self._extra_job_config(),
         }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def _job_context(cls, cfg: Dict[str, Any], s0_nodes) -> Dict[str, Any]:
+        """Hook: load per-job solver state (lifted edge lists etc.)."""
+        return {}
+
+    @classmethod
+    def _solve_block(cls, cfg: Dict[str, Any], ctx: Dict[str, Any],
+                     nodes_dense: np.ndarray, inner: np.ndarray,
+                     uv_dense: np.ndarray, costs: np.ndarray) -> np.ndarray:
+        """Hook: solve one block's subproblem -> labeling over the block's
+        local (unique-compacted) nodes' cut mask; returns inner cut ids."""
+        agglomerator = key_to_agglomerator(
+            cfg.get("agglomerator", "kernighan-lin"))
+        sub_uv = uv_dense[inner]
+        sub_nodes, local_uv_flat = np.unique(sub_uv, return_inverse=True)
+        local_uv = local_uv_flat.reshape(-1, 2).astype("int64")
+        sub_costs = costs[inner]
+        sub_res = agglomerator(len(sub_nodes), local_uv, sub_costs)
+        cut_mask = sub_res[local_uv[:, 0]] != sub_res[local_uv[:, 1]]
+        return inner[cut_mask]
 
     @classmethod
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
         cfg = job_config["config"]
         problem_path = cfg["problem_path"]
         scale = int(cfg["scale"])
-        agglomerator = key_to_agglomerator(
-            cfg.get("agglomerator", "kernighan-lin"))
 
         uv_dense, n_nodes, s0_nodes = _load_scale_graph(problem_path, scale)
         costs = _load_costs(problem_path, scale)
         graph = g.Graph(np.arange(n_nodes, dtype="uint64"),
                         uv_dense.astype("uint64"))
+        ctx = cls._job_context(cfg, s0_nodes)
         os.makedirs(os.path.join(problem_path, f"s{scale}", "sub_results"),
                     exist_ok=True)
 
@@ -114,13 +162,9 @@ class SolveSubproblems(BlockTask):
             if len(inner) == 0:
                 cut_ids = outer
             else:
-                sub_uv = uv_dense[inner]
-                sub_nodes, local_uv_flat = np.unique(sub_uv, return_inverse=True)
-                local_uv = local_uv_flat.reshape(-1, 2).astype("int64")
-                sub_costs = costs[inner]
-                sub_res = agglomerator(len(sub_nodes), local_uv, sub_costs)
-                cut_mask = sub_res[local_uv[:, 0]] != sub_res[local_uv[:, 1]]
-                cut_ids = np.concatenate([inner[cut_mask], outer])
+                cut_inner = cls._solve_block(cfg, ctx, nodes_dense, inner,
+                                             uv_dense, costs)
+                cut_ids = np.concatenate([cut_inner, outer])
             path = _sub_result_path(problem_path, scale, block_id)
             tmp = path + ".tmp.npz"
             np.savez(tmp, cut_edge_ids=cut_ids.astype("int64"))
@@ -255,6 +299,13 @@ class ReduceProblem(BlockTask):
                                     chunks=(max(len(new_initial), 1),),
                                     dtype="uint64")
             ds2[:] = new_initial
+            # scale-local (s -> s+1) labeling: the lifted reduce step maps
+            # its scale-s lifted pairs through this
+            ds3 = f.require_dataset(f"s{next_scale}/scale_node_labeling",
+                                    shape=(len(node_labeling),),
+                                    chunks=(max(len(node_labeling), 1),),
+                                    dtype="uint64")
+            ds3[:] = node_labeling
         log_fn(f"reduced problem: {len(new_uv)} edges at scale {next_scale}")
 
 
@@ -302,22 +353,9 @@ class SolveGlobal(BlockTask):
         log_fn(f"global solve: {n_nodes} nodes -> "
                f"{len(np.unique(labels))} segments")
 
-        # compose back to s0 fragments
-        if scale == 0:
-            final = labels
-        else:
-            with file_reader(problem_path, "r") as f:
-                initial = f[f"s{scale}/node_labeling"][:]
-            final = labels[initial.astype("int64")]
+        final = compose_to_s0(problem_path, scale, labels)
         nodes0, _, _ = g.load_graph(problem_path, "s0/graph")
-
-        # inflate to a dense assignment table over [0, max_label]; 0 and gaps
-        # stay background; segment ids start at 1
-        _, consecutive = np.unique(final, return_inverse=True)
-        max_label = int(nodes0.max()) if len(nodes0) else 0
-        table = np.zeros(max_label + 1, dtype="uint64")
-        table[nodes0.astype("int64")] = consecutive.astype("uint64") + 1
-        np.save(cfg["assignment_path"], table)
+        table = save_assignment_table(nodes0, final, cfg["assignment_path"])
         log_fn(f"assignments saved: {len(table)} fragment ids")
 
 

@@ -1,0 +1,200 @@
+"""Lifted multicut stack tests: solver oracles, lifted-neighborhood BFS
+oracle, and the end-to-end LiftedMulticutSegmentationWorkflow."""
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.core.storage import file_reader
+
+
+def test_lifted_solver_known_instances():
+    from cluster_tools_tpu import native
+
+    # square 0-1-2-3-0, all local edges attractive; strong lifted repulsion
+    # across the diagonal must cut the square (optimum: -8)
+    uv = np.array([[0, 1], [1, 2], [2, 3], [3, 0]], "int64")
+    c = np.ones(4)
+    luv = np.array([[0, 2]], "int64")
+    lc = np.array([-10.0])
+    lab = native.lifted_multicut_kernighan_lin(4, uv, c, luv, lc)
+    assert lab[0] != lab[2]
+    assert native.lifted_objective(uv, c, luv, lc, lab) == -8.0
+
+    # without lifted edges the lifted solver degrades to plain multicut
+    lab = native.lifted_multicut_kernighan_lin(
+        4, uv, c, np.zeros((0, 2), "int64"), np.zeros(0))
+    assert len(np.unique(lab)) == 1
+
+    # attractive lifted edge overcomes a weak local repulsion: chain 0-1-2
+    # with local costs (+0.2, -0.1) and lifted 0-2 at +1.  After contracting
+    # (0,1), the pair ({0,1}, 2) has priority -0.1 + 1.0 > 0 -> one cluster
+    # (cutting would pay the lifted cost).
+    uv = np.array([[0, 1], [1, 2]], "int64")
+    c = np.array([0.2, -0.1])
+    luv = np.array([[0, 2]], "int64")
+    lc = np.array([1.0])
+    lab = native.lifted_multicut_kernighan_lin(3, uv, c, luv, lc)
+    assert len(np.unique(lab)) == 1
+    # with a repulsive lifted edge instead, node 2 stays separate
+    lab = native.lifted_multicut_kernighan_lin(
+        3, uv, c, luv, np.array([-1.0]))
+    assert lab[0] == lab[1] and lab[0] != lab[2]
+
+
+def test_lifted_solver_beats_baselines_random():
+    from cluster_tools_tpu import native
+
+    rng = np.random.RandomState(1)
+    for _ in range(5):
+        n = 7
+        uv = np.array([(i, j) for i in range(n) for j in range(i + 1, n)
+                       if rng.rand() < 0.5], "int64")
+        if len(uv) == 0:
+            continue
+        c = rng.randn(len(uv))
+        luv = np.array([(i, j) for i in range(n) for j in range(i + 1, n)
+                        if rng.rand() < 0.2], "int64")
+        lc = rng.randn(len(luv)) * 2
+        lab = native.lifted_multicut_kernighan_lin(n, uv, c, luv, lc)
+        obj = native.lifted_objective(uv, c, luv, lc, lab)
+        # must beat the trivial partitions
+        all_one = np.zeros(n, "uint64")
+        all_split = np.arange(n, dtype="uint64")
+        assert obj <= native.lifted_objective(uv, c, luv, lc, all_one) + 1e-9
+        assert obj <= native.lifted_objective(uv, c, luv, lc, all_split) + 1e-9
+
+
+def test_lifted_neighborhood_bfs_oracle():
+    from cluster_tools_tpu.workflows.lifted_features import (
+        lifted_neighborhood)
+
+    # path graph 0-1-2-3-4
+    uv = np.array([[0, 1], [1, 2], [2, 3], [3, 4]], "int64")
+    labels = np.array([1, 1, 2, 2, 1], "uint64")
+
+    pairs = lifted_neighborhood(uv, 5, labels, graph_depth=2)
+    assert sorted(map(tuple, pairs.tolist())) == [(0, 2), (1, 3), (2, 4)]
+
+    pairs = lifted_neighborhood(uv, 5, labels, graph_depth=3)
+    assert sorted(map(tuple, pairs.tolist())) == [
+        (0, 2), (0, 3), (1, 3), (1, 4), (2, 4)]
+
+    # mode filters
+    pairs = lifted_neighborhood(uv, 5, labels, graph_depth=3, mode="same")
+    same = set(map(tuple, pairs.tolist()))
+    assert same == {(1, 4)}
+    assert all(labels[a] == labels[b] for a, b in same)
+    diff = set(map(tuple, lifted_neighborhood(
+        uv, 5, labels, graph_depth=3, mode="different").tolist()))
+    assert all(labels[a] != labels[b] for a, b in diff)
+    assert same | diff == {(0, 2), (0, 3), (1, 3), (1, 4), (2, 4)}
+
+    # ignore label: node 2 unlabeled -> no paths through it
+    labels2 = np.array([1, 1, 0, 2, 2], "uint64")
+    pairs = lifted_neighborhood(uv, 5, labels2, graph_depth=4)
+    assert (2 not in pairs.ravel())
+    # 0-1 and 3-4 components are disconnected without node 2: no cross pairs
+    assert len(pairs) == 0
+
+
+def test_lifted_segmentation_workflow(tmp_path, tmp_workdir):
+    """E2E: semantic priors via lifted edges keep cells of different labels
+    apart even where the boundary evidence is weak."""
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.workflows.segmentation import (
+        LiftedMulticutSegmentationWorkflow)
+    from tests.test_multicut import (_boundary_map, _check_recovery,
+                                     _nested_voronoi)
+
+    tmp_folder, config_dir = tmp_workdir
+    true, frags = _nested_voronoi()
+    bnd = _boundary_map(true)
+
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.create_dataset("bmap", data=bnd, chunks=(12, 12, 12))
+        ds = f.create_dataset("ws", data=frags, chunks=(12, 12, 12))
+        ds.attrs["maxId"] = int(frags.max())
+        # semantic prior = the true cells themselves (the strongest prior)
+        f.create_dataset("sem", data=true, chunks=(12, 12, 12))
+
+    wf = LiftedMulticutSegmentationWorkflow(
+        input_path=path, input_key="bmap", ws_path=path, ws_key="ws",
+        labels_path=path, labels_key="sem",
+        problem_path=str(tmp_path / "problem.n5"), output_path=path,
+        output_key="seg", lifted_prefix="sem",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads", n_scales=1, nh_graph_depth=3)
+    assert ctt.build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        seg = f["seg"][:]
+    _check_recovery(true, seg)
+
+
+def test_agglomerative_clustering_workflow(tmp_path, tmp_workdir):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.workflows.segmentation import (
+        AgglomerativeClusteringWorkflow)
+    from tests.test_multicut import _boundary_map, _nested_voronoi
+
+    tmp_folder, config_dir = tmp_workdir
+    true, frags = _nested_voronoi()
+    bnd = _boundary_map(true)
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.create_dataset("bmap", data=bnd, chunks=(12, 12, 12))
+        f.create_dataset("ws", data=frags, chunks=(12, 12, 12))
+
+    wf = AgglomerativeClusteringWorkflow(
+        input_path=path, input_key="bmap", ws_path=path, ws_key="ws",
+        problem_path=str(tmp_path / "problem.n5"), output_path=path,
+        output_key="seg", threshold=0.5,
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert ctt.build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        seg = f["seg"][:]
+    # clustering below threshold 0.5 must merge fragments inside cells
+    # (interior edges have ~0 boundary evidence) and not across (ridge = 1)
+    n_frags = len(np.unique(frags))
+    n_seg = len(np.unique(seg))
+    assert n_seg < n_frags / 2
+    # no merges across true boundaries for the bulk of voxels: each segment's
+    # dominant true cell covers >= 95% of it
+    for sid in np.unique(seg):
+        cells, counts = np.unique(true[seg == sid], return_counts=True)
+        assert counts.max() / counts.sum() > 0.95
+
+
+def test_simple_stitching_workflow_e2e(tmp_path, tmp_workdir):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.workflows.segmentation import (
+        SimpleStitchingWorkflow)
+    from tests.test_stitching import _split_label_volume
+
+    tmp_folder, config_dir = tmp_workdir
+    shape, block_shape = (20, 20, 20), (10, 10, 10)
+    truth, split = _split_label_volume(shape, block_shape, n_cells=3, seed=5)
+    uniq = np.unique(split)
+    split = np.searchsorted(uniq, split).astype("uint64") + 1
+    bmap = np.zeros(shape, "float32")
+
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        f.create_dataset("ws", data=split, chunks=block_shape)
+        f.create_dataset("bmap", data=bmap, chunks=block_shape)
+
+    wf = SimpleStitchingWorkflow(
+        input_path=path, input_key="bmap", ws_path=path, ws_key="ws",
+        problem_path=str(tmp_path / "problem.n5"), output_path=path,
+        output_key="seg", tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert ctt.build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        seg = f["seg"][:]
+    # all fragments of one truth cell end up in one segment (no splits)
+    for cell in np.unique(truth):
+        assert len(np.unique(seg[truth == cell])) == 1
