@@ -533,3 +533,15 @@ def file_reader(path: str, mode: str = "a"):
 def get_shape(path: str, key: str) -> Tuple[int, ...]:
     with file_reader(path, "r") as f:
         return tuple(f[key].shape)
+
+
+def read_max_id(path: str, key: str) -> int:
+    """The maxId dataset attribute (written by the write tasks) as int;
+    raises with guidance when absent."""
+    with file_reader(path, "r") as f:
+        ds = f[key]
+        if "maxId" in ds.attrs:
+            return int(ds.attrs["maxId"])
+    raise ValueError(
+        f"{path}:{key} has no maxId attribute; write tasks record it -- "
+        "pass n_labels explicitly for volumes produced outside the framework")

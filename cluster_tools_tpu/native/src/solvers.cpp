@@ -606,4 +606,159 @@ void graph_watershed(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
     }
 }
 
+// ---------------------------------------------------------------------------
+// 3d skeletonization by topological thinning
+// (skimage.morphology.skeletonize_3d replacement for the skeletons
+// component, reference: skeletons/skeletonize.py:129-157; skimage is not in
+// the image, so the thinning is first-party)
+// ---------------------------------------------------------------------------
+namespace {
+
+inline int manhattan(int i) {  // local 3x3x3 index -> |dz|+|dy|+|dx|
+    int z = i / 9 - 1, y = (i / 3) % 3 - 1, x = i % 3 - 1;
+    return std::abs(z) + std::abs(y) + std::abs(x);
+}
+
+// number of 26-connected components of OBJECT voxels in the 26-neighborhood
+// (center excluded)
+int cc_object_26(const bool* m) {
+    int comp[27];
+    for (int i = 0; i < 27; ++i) comp[i] = -1;
+    int n_comp = 0;
+    for (int seed = 0; seed < 27; ++seed) {
+        if (seed == 13 || !m[seed] || comp[seed] != -1) continue;
+        int stack[27], sp = 0;
+        stack[sp++] = seed;
+        comp[seed] = n_comp;
+        while (sp) {
+            int cur = stack[--sp];
+            int cz = cur / 9, cy = (cur / 3) % 3, cx = cur % 3;
+            for (int oz = -1; oz <= 1; ++oz)
+                for (int oy = -1; oy <= 1; ++oy)
+                    for (int ox = -1; ox <= 1; ++ox) {
+                        if (!(oz | oy | ox)) continue;
+                        int nz = cz + oz, ny = cy + oy, nx = cx + ox;
+                        if (nz < 0 || nz > 2 || ny < 0 || ny > 2 ||
+                            nx < 0 || nx > 2) continue;
+                        int nidx = nz * 9 + ny * 3 + nx;
+                        if (nidx == 13 || !m[nidx] || comp[nidx] != -1)
+                            continue;
+                        comp[nidx] = n_comp;
+                        stack[sp++] = nidx;
+                    }
+        }
+        ++n_comp;
+    }
+    return n_comp;
+}
+
+// number of 6-connected components of BACKGROUND voxels in the
+// 18-neighborhood that contain a face-neighbor of the center
+int cc_background_6(const bool* m) {
+    int comp[27];
+    for (int i = 0; i < 27; ++i) comp[i] = -1;
+    int n_comp = 0;
+    for (int seed = 0; seed < 27; ++seed) {
+        if (seed == 13 || m[seed] || comp[seed] != -1) continue;
+        if (manhattan(seed) > 2) continue;  // corners not in N18
+        int stack[27], sp = 0;
+        stack[sp++] = seed;
+        comp[seed] = 0;
+        bool touches = manhattan(seed) == 1;
+        while (sp) {
+            int cur = stack[--sp];
+            int cz = cur / 9, cy = (cur / 3) % 3, cx = cur % 3;
+            const int d6[6][3] = {{-1, 0, 0}, {1, 0, 0}, {0, -1, 0},
+                                  {0, 1, 0},  {0, 0, -1}, {0, 0, 1}};
+            for (const auto& d : d6) {
+                int nz = cz + d[0], ny = cy + d[1], nx = cx + d[2];
+                if (nz < 0 || nz > 2 || ny < 0 || ny > 2 ||
+                    nx < 0 || nx > 2) continue;
+                int nidx = nz * 9 + ny * 3 + nx;
+                if (nidx == 13 || m[nidx] || comp[nidx] != -1) continue;
+                if (manhattan(nidx) > 2) continue;
+                comp[nidx] = 0;
+                stack[sp++] = nidx;
+                if (manhattan(nidx) == 1) touches = true;
+            }
+        }
+        if (touches) ++n_comp;
+    }
+    return n_comp;
+}
+
+}  // namespace
+
+// Thin a binary volume to a 1-voxel-wide skeleton.  `vol` is 0/1 uint8 of
+// shape (sz, sy, sx), modified in place.  Border-peeling with the standard
+// simple-point test (object stays 26-connected, background stays
+// 6-connected across the deletion) and curve-endpoint preservation.
+void skeletonize_3d(uint8_t* vol, int64_t sz, int64_t sy, int64_t sx) {
+    auto at = [&](int64_t z, int64_t y, int64_t x) -> uint8_t {
+        if (z < 0 || z >= sz || y < 0 || y >= sy || x < 0 || x >= sx)
+            return 0;
+        return vol[z * sy * sx + y * sx + x];
+    };
+    std::vector<int64_t> candidates;
+    bool changed = true;
+    while (changed) {
+        changed = false;
+        // six directional sub-iterations keep the skeleton centered
+        const int dirs[6][3] = {{-1, 0, 0}, {1, 0, 0}, {0, -1, 0},
+                                {0, 1, 0},  {0, 0, -1}, {0, 0, 1}};
+        for (const auto& d : dirs) {
+            candidates.clear();
+            for (int64_t z = 0; z < sz; ++z)
+                for (int64_t y = 0; y < sy; ++y)
+                    for (int64_t x = 0; x < sx; ++x) {
+                        int64_t idx = z * sy * sx + y * sx + x;
+                        if (!vol[idx]) continue;
+                        // border in direction d
+                        if (at(z + d[0], y + d[1], x + d[2])) continue;
+                        // endpoint: exactly one object neighbor -> keep
+                        int n_obj = 0;
+                        for (int oz = -1; oz <= 1; ++oz)
+                            for (int oy = -1; oy <= 1; ++oy)
+                                for (int ox = -1; ox <= 1; ++ox)
+                                    if ((oz | oy | ox) &&
+                                        at(z + oz, y + oy, x + ox))
+                                        ++n_obj;
+                        if (n_obj <= 1) continue;
+                        // simple point test on the 3x3x3 neighborhood
+                        bool m[27];
+                        for (int oz = -1; oz <= 1; ++oz)
+                            for (int oy = -1; oy <= 1; ++oy)
+                                for (int ox = -1; ox <= 1; ++ox)
+                                    m[(oz + 1) * 9 + (oy + 1) * 3 + ox + 1] =
+                                        at(z + oz, y + oy, x + ox) != 0;
+                        if (cc_object_26(m) != 1) continue;
+                        if (cc_background_6(m) != 1) continue;
+                        candidates.push_back(idx);
+                    }
+            // delete sequentially, re-checking the simple-point condition
+            // (a neighbor deleted earlier in this pass can change it)
+            for (int64_t idx : candidates) {
+                int64_t z = idx / (sy * sx), y = (idx / sx) % sy, x = idx % sx;
+                int n_obj = 0;
+                for (int oz = -1; oz <= 1; ++oz)
+                    for (int oy = -1; oy <= 1; ++oy)
+                        for (int ox = -1; ox <= 1; ++ox)
+                            if ((oz | oy | ox) && at(z + oz, y + oy, x + ox))
+                                ++n_obj;
+                if (n_obj <= 1) continue;
+                bool m[27];
+                for (int oz = -1; oz <= 1; ++oz)
+                    for (int oy = -1; oy <= 1; ++oy)
+                        for (int ox = -1; ox <= 1; ++ox)
+                            m[(oz + 1) * 9 + (oy + 1) * 3 + ox + 1] =
+                                at(z + oz, y + oy, x + ox) != 0;
+                if (cc_object_26(m) != 1) continue;
+                if (cc_background_6(m) != 1) continue;
+                vol[idx] = 0;
+                changed = true;
+            }
+        }
+    }
+}
+
 }  // extern "C"

@@ -8,8 +8,13 @@ from .postprocess import (ConnectedComponentsWorkflow, FilterLabelsWorkflow,
                           FilterOrphansWorkflow,
                           SizeFilterAndGraphWatershedWorkflow,
                           SizeFilterWorkflow)
+from .learning import LearningWorkflow
 from .lifted_features import LiftedFeaturesFromNodeLabelsWorkflow
 from .lifted_multicut import LiftedMulticutWorkflow
+from .morphology import MorphologyWorkflow
+from .postprocess import FilterByThresholdWorkflow
+from .region_features import RegionFeaturesWorkflow
+from .skeletons import SkeletonWorkflow
 from .relabel import RelabelWorkflow
 from .segmentation import (AgglomerativeClusteringWorkflow,
                            LiftedMulticutSegmentationWorkflow,
@@ -23,8 +28,11 @@ from .watershed import (AgglomerateTask, WatershedFromSeedsTask,
 __all__ = [
     "AgglomerateTask", "AgglomerativeClusteringWorkflow",
     "ConnectedComponentsWorkflow", "FilterLabelsWorkflow",
+    "FilterByThresholdWorkflow",
     "FilterOrphansWorkflow", "GraphWorkflow", "InferenceTask",
+    "LearningWorkflow",
     "LiftedFeaturesFromNodeLabelsWorkflow",
+    "MorphologyWorkflow", "RegionFeaturesWorkflow", "SkeletonWorkflow",
     "LiftedMulticutSegmentationWorkflow", "LiftedMulticutWorkflow",
     "MulticutWorkflow", "MwsWorkflow", "TwoPassMwsWorkflow",
     "SimpleStitchingWorkflow",

@@ -35,14 +35,7 @@ def overlaps_dir(tmp_folder: str, prefix: str) -> str:
     return os.path.join(tmp_folder, f"overlaps_{prefix}" if prefix else "overlaps")
 
 
-def _read_max_id(path: str, key: str) -> int:
-    with file_reader(path, "r") as f:
-        ds = f[key]
-        if "maxId" in ds.attrs:
-            return int(ds.attrs["maxId"])
-    raise ValueError(
-        f"{path}:{key} has no maxId attribute; write tasks record it — "
-        "pass n_labels explicitly for volumes produced outside the framework")
+from ..core.storage import read_max_id as _read_max_id  # noqa: E402
 
 
 class BlockNodeLabels(BlockTask):

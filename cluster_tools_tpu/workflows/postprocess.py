@@ -598,6 +598,112 @@ class FilterLabelsWorkflow(Task):
                                        "filter_blocks.status"))
 
 
+class ApplyThreshold(BlockTask):
+    """Threshold a per-node feature vector -> filtered-id json (reference:
+    postprocess_workflow.py:164-196 ApplyThreshold)."""
+
+    task_name = "apply_threshold"
+    global_task = True
+    allow_retry = False
+
+    _MODES = ("less", "greater", "equal")
+
+    def __init__(self, feature_path: str, feature_key: str, out_path: str,
+                 threshold: float, threshold_mode: str = "less", **kw):
+        if threshold_mode not in self._MODES:
+            raise ValueError(f"threshold_mode must be one of {self._MODES}")
+        self.feature_path = feature_path
+        self.feature_key = feature_key
+        self.out_path = out_path
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        super().__init__(**kw)
+
+    def run_impl(self):
+        self.run_jobs(None, {
+            "feature_path": self.feature_path,
+            "feature_key": self.feature_key, "out_path": self.out_path,
+            "threshold": self.threshold,
+            "threshold_mode": self.threshold_mode,
+        })
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        with file_reader(cfg["feature_path"], "r") as f:
+            feats = f[cfg["feature_key"]][:]
+        mode = cfg["threshold_mode"]
+        if mode == "less":
+            mask = feats < cfg["threshold"]
+        elif mode == "greater":
+            mask = feats > cfg["threshold"]
+        else:
+            mask = feats == cfg["threshold"]
+        filter_ids = np.flatnonzero(mask)
+        with open(cfg["out_path"], "w") as f:
+            json.dump([int(i) for i in filter_ids], f)
+        log_fn(f"filtering {len(filter_ids)} / {len(feats)} ids "
+               f"({mode} {cfg['threshold']})")
+
+
+class FilterByThresholdWorkflow(Task):
+    """Region features -> threshold -> zero out filtered segments ->
+    optional relabel (reference: postprocess_workflow.py:198-250)."""
+
+    def __init__(self, input_path: str, input_key: str, seg_in_path: str,
+                 seg_in_key: str, seg_out_path: str, seg_out_key: str,
+                 threshold: float, tmp_folder: str, config_dir: str,
+                 max_jobs: int = 1, target: str = "local",
+                 relabel: bool = True, dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.seg_in_path = seg_in_path
+        self.seg_in_key = seg_in_key
+        self.seg_out_path = seg_out_path
+        self.seg_out_key = seg_out_key
+        self.threshold = threshold
+        self.relabel = relabel
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def requires(self):
+        from .region_features import RegionFeaturesWorkflow
+
+        common = dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                      max_jobs=self.max_jobs, target=self.target)
+        feat_path = os.path.join(self.tmp_folder, "reg_feats.n5")
+        feats = RegionFeaturesWorkflow(
+            input_path=self.input_path, input_key=self.input_key,
+            labels_path=self.seg_in_path, labels_key=self.seg_in_key,
+            output_path=feat_path, output_key="feats",
+            dependency=self.dependency, **common)
+        id_filter_path = os.path.join(self.tmp_folder, "filtered_ids.json")
+        thresh = ApplyThreshold(
+            feature_path=feat_path, feature_key="feats",
+            out_path=id_filter_path, threshold=self.threshold,
+            dependency=feats, **common)
+        dep: Task = FilterBlocks(
+            input_path=self.seg_in_path, input_key=self.seg_in_key,
+            output_path=self.seg_out_path, output_key=self.seg_out_key,
+            filter_path=id_filter_path, dependency=thresh, **common)
+        if self.relabel:
+            dep = RelabelWorkflow(
+                input_path=self.seg_out_path, input_key=self.seg_out_key,
+                identifier="relabel_filter", dependency=dep, **common)
+        return dep
+
+    def output(self):
+        if self.relabel:
+            return FileTarget(os.path.join(self.tmp_folder,
+                                           "write_relabel_filter.status"))
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "filter_blocks.status"))
+
+
 class ConnectedComponentsWorkflow(Task):
     """GraphConnectedComponents -> optional Write (reference:
     postprocess_workflow.py:296-340)."""

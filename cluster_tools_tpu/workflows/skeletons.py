@@ -1,0 +1,226 @@
+"""Per-object skeletonization and skeleton metrics.
+
+Re-specification of the reference's ``skeletons/`` package
+(skeletonize.py:129-157 — thinning per object over label-id ranges, using
+the morphology table's bounding boxes; skeleton_evaluation.py:96 — skeleton
+metrics vs a groundtruth segmentation).  Skeletons are stored as flat voxel
+coordinate arrays per label in a VarlenDataset (the reference serializes
+per-object skeletons into varlen n5 chunks).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.runtime import BlockTask
+from ..core.storage import VarlenDataset, file_reader
+from ..core.workflow import FileTarget, Task
+from .morphology import MorphologyWorkflow
+
+
+def skeletonize_object(obj: np.ndarray) -> np.ndarray:
+    """(K, 3) voxel coordinates of the 3d thinning skeleton (first-party
+    native topological thinning; skimage is not in the image)."""
+    from ..native import skeletonize_3d
+
+    skel = skeletonize_3d(obj)
+    return np.stack(np.nonzero(skel), axis=1).astype("uint64")
+
+
+class Skeletonize(BlockTask):
+    """Skeletonize each object inside its bounding box, sharded over
+    label-id ranges (reference: skeletonize.py:129-157)."""
+
+    task_name = "skeletonize"
+
+    def __init__(self, input_path: str, input_key: str,
+                 morphology_path: str, morphology_key: str,
+                 output_path: str, output_key: str,
+                 n_labels: Optional[int] = None, **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.morphology_path = morphology_path
+        self.morphology_key = morphology_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.n_labels = n_labels
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"id_chunk_size": 1000, "size_threshold": 0})
+        return conf
+
+    def run_impl(self):
+        from ..core.storage import read_max_id
+
+        if self.n_labels is None:
+            self.n_labels = read_max_id(self.input_path,
+                                        self.input_key) + 1
+        chunk = int(self.task_config.get("id_chunk_size", 1000))
+        n_chunks = (self.n_labels + chunk - 1) // chunk or 1
+        self.run_jobs(list(range(n_chunks)), {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "morphology_path": self.morphology_path,
+            "morphology_key": self.morphology_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "n_labels": self.n_labels, "id_chunk_size": chunk,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        chunk, n_labels = cfg["id_chunk_size"], cfg["n_labels"]
+        size_threshold = cfg.get("size_threshold", 0)
+        with file_reader(cfg["morphology_path"], "r") as f:
+            morpho = f[cfg["morphology_key"]][:]
+        sizes = morpho[:, 1]
+        bb_min = morpho[:, 5:8].astype("int64")
+        bb_max = morpho[:, 8:11].astype("int64") + 1
+        f_in = file_reader(cfg["input_path"], "r")
+        ds_in = f_in[cfg["input_key"]]
+        out = VarlenDataset(os.path.join(cfg["output_path"],
+                                         cfg["output_key"]), dtype="uint64")
+
+        for block_id in job_config["block_list"]:
+            lo, hi = block_id * chunk, min((block_id + 1) * chunk, n_labels)
+            for label_id in range(max(lo, 1), hi):  # 0 = ignore label
+                if sizes[label_id] == 0 or (
+                        size_threshold and sizes[label_id] < size_threshold):
+                    continue
+                bb = tuple(slice(b, e) for b, e in
+                           zip(bb_min[label_id], bb_max[label_id]))
+                obj = np.asarray(ds_in[bb]) == label_id
+                if not obj.any():
+                    continue
+                coords = skeletonize_object(obj)
+                coords += np.asarray([b.start for b in bb], "uint64")[None]
+                out.write_chunk((label_id,), coords.ravel())
+            log_fn(f"processed block {block_id}")
+
+
+def load_skeleton(output_path: str, output_key: str,
+                  label_id: int) -> Optional[np.ndarray]:
+    """(K, 3) skeleton coordinates of one object, or None."""
+    ds = VarlenDataset(os.path.join(output_path, output_key), dtype="uint64")
+    flat = ds.read_chunk((label_id,))
+    if flat is None:
+        return None
+    return flat.reshape(-1, 3)
+
+
+class SkeletonEvaluation(BlockTask):
+    """Skeleton-based split/merge metrics vs a segmentation (reference:
+    skeleton_evaluation.py:96 via nifty SkeletonMetrics): for each skeleton,
+    the fraction of its nodes carrying the dominant segment label
+    (correctness); plus the count of false merges (two skeletons sharing a
+    dominant segment)."""
+
+    task_name = "skeleton_evaluation"
+    global_task = True
+    allow_retry = False
+
+    def __init__(self, skeleton_path: str, skeleton_key: str, seg_path: str,
+                 seg_key: str, n_labels: int, output_path: str, **kw):
+        self.skeleton_path = skeleton_path
+        self.skeleton_key = skeleton_key
+        self.seg_path = seg_path
+        self.seg_key = seg_key
+        self.n_labels = n_labels
+        self.output_path = output_path
+        super().__init__(**kw)
+
+    def run_impl(self):
+        self.run_jobs(None, {
+            "skeleton_path": self.skeleton_path,
+            "skeleton_key": self.skeleton_key,
+            "seg_path": self.seg_path, "seg_key": self.seg_key,
+            "n_labels": self.n_labels, "output_path": self.output_path,
+        })
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        import json
+
+        cfg = job_config["config"]
+        f_seg = file_reader(cfg["seg_path"], "r")
+        ds_seg = f_seg[cfg["seg_key"]]
+        correctness = {}
+        dominant = {}
+        for label_id in range(1, cfg["n_labels"]):
+            coords = load_skeleton(cfg["skeleton_path"],
+                                   cfg["skeleton_key"], label_id)
+            if coords is None or len(coords) == 0:
+                continue
+            # read only the skeleton's bounding box (volumes are
+            # cluster-scale; a full read would OOM the single global job)
+            c = coords.astype("int64")
+            lo, hi = c.min(0), c.max(0) + 1
+            sub = np.asarray(ds_seg[tuple(slice(a, b)
+                                          for a, b in zip(lo, hi))])
+            labels = sub[tuple((c - lo).T)]
+            ids, counts = np.unique(labels, return_counts=True)
+            best = int(ids[np.argmax(counts)])
+            correctness[label_id] = float(counts.max() / counts.sum())
+            dominant[label_id] = best
+        doms = list(dominant.values())
+        n_merges = len(doms) - len(set(doms))
+        result = {
+            "mean_correctness": float(np.mean(list(correctness.values())))
+            if correctness else 0.0,
+            "n_skeletons": len(correctness),
+            "n_false_merges": int(n_merges),
+            "per_object_correctness": {str(k): v
+                                       for k, v in correctness.items()},
+        }
+        with open(cfg["output_path"], "w") as f:
+            json.dump(result, f)
+        log_fn(f"skeleton eval: correctness="
+               f"{result['mean_correctness']:.4f}, "
+               f"{n_merges} false merges over {len(correctness)} skeletons")
+
+
+class SkeletonWorkflow(Task):
+    """MorphologyWorkflow -> Skeletonize (reference: skeleton_workflow.py)."""
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, tmp_folder: str, config_dir: str,
+                 max_jobs: int = 1, target: str = "local",
+                 n_labels: Optional[int] = None,
+                 morphology_key: str = "morphology",
+                 dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.n_labels = n_labels
+        self.morphology_key = morphology_key
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def requires(self):
+        common = dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                      max_jobs=self.max_jobs, target=self.target)
+        morpho = MorphologyWorkflow(
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.output_path, output_key=self.morphology_key,
+            n_labels=self.n_labels, prefix="skel",
+            dependency=self.dependency, **common)
+        return Skeletonize(
+            input_path=self.input_path, input_key=self.input_key,
+            morphology_path=self.output_path,
+            morphology_key=self.morphology_key,
+            output_path=self.output_path, output_key=self.output_key,
+            n_labels=self.n_labels, dependency=morpho, **common)
+
+    def output(self):
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "skeletonize.status"))

@@ -1,0 +1,252 @@
+"""Region features, RF learning, morphology, skeletons — numpy-oracle tests
+(reference test style: recompute-in-numpy, SURVEY §4)."""
+
+import json
+import os
+import pickle
+
+import numpy as np
+
+from cluster_tools_tpu.core.storage import file_reader
+from cluster_tools_tpu.core.workflow import build
+
+
+def _seg_and_data(shape=(16, 16, 16), seed=0):
+    rng = np.random.RandomState(seed)
+    seg = np.zeros(shape, "uint64")
+    seg[:, :8, :] = 1
+    seg[:, 8:, :] = 2
+    seg[4:8, 4:8, 4:8] = 3
+    data = rng.rand(*shape).astype("float32")
+    return seg, data
+
+
+def test_region_features_workflow(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.region_features import (
+        RegionFeaturesWorkflow)
+
+    tmp_folder, config_dir = tmp_workdir
+    seg, data = _seg_and_data()
+    path = str(tmp_path / "d.n5")
+    out = str(tmp_path / "f.n5")
+    with file_reader(path) as f:
+        f.create_dataset("data", data=data, chunks=[8, 8, 8])
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = int(seg.max())
+
+    wf = RegionFeaturesWorkflow(
+        input_path=path, input_key="data", labels_path=path,
+        labels_key="seg", output_path=out, output_key="feats",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(out, "r") as f:
+        mean = f["feats"][:]
+        counts = f["feats_counts"][:]
+    for lbl in (1, 2, 3):
+        m = seg == lbl
+        np.testing.assert_allclose(mean[lbl], data[m].mean(), rtol=1e-5)
+        assert counts[lbl] == m.sum()
+    # ignore label 0 has no voxels here; its row stays zero
+    assert counts[0] == 0
+
+
+def test_learning_and_predict_roundtrip(tmp_workdir, tmp_path):
+    """EdgeLabels -> LearnRF -> RFPredict on a separable toy problem."""
+    from cluster_tools_tpu.core.graph import save_graph
+    from cluster_tools_tpu.workflows.learning import (EdgeLabels, LearnRF,
+                                                      RFPredict)
+
+    tmp_folder, config_dir = tmp_workdir
+    problem = str(tmp_path / "p.n5")
+    rng = np.random.RandomState(0)
+    n_edges = 200
+    # feature 0 separates cut (high) from merge (low) edges
+    labels = (rng.rand(n_edges) > 0.5).astype("int8")
+    feats = np.zeros((n_edges, 10), "float32")
+    feats[:, 0] = labels + 0.1 * rng.randn(n_edges)
+    # node labels consistent with edge labels: chain graph u=i, v=i+1
+    uv = np.stack([np.arange(n_edges), np.arange(1, n_edges + 1)], 1)
+    node_labels = np.zeros(n_edges + 1, "uint64")
+    node_labels[0] = 1
+    for i in range(n_edges):
+        node_labels[i + 1] = node_labels[i] + labels[i]
+    node_labels += 1  # keep away from the gt ignore label 0
+
+    save_graph(problem, "s0/graph",
+               np.arange(n_edges + 1, dtype="uint64"), uv.astype("uint64"),
+               (1, 1, 1))
+    with file_reader(problem) as f:
+        f.create_dataset("features", data=feats)
+        f.create_dataset("gt_labels", data=node_labels)
+
+    common = dict(tmp_folder=tmp_folder, config_dir=config_dir,
+                  max_jobs=2, target="threads")
+    el = EdgeLabels(
+        graph_path=problem, graph_key="s0/graph",
+        node_labels_path=problem, node_labels_key="gt_labels",
+        output_path=problem, output_key="edge_labels", **common)
+    rf_path = str(tmp_path / "rf.pkl")
+    rf = LearnRF(features_dict={"a": (problem, "features")},
+                 labels_dict={"a": (problem, "edge_labels")},
+                 output_path=rf_path, dependency=el, **common)
+    pred = RFPredict(
+        rf_path=rf_path, features_path=problem, features_key="features",
+        output_path=problem, output_key="probs", dependency=rf, **common)
+    assert build([pred], raise_on_failure=True)
+
+    with file_reader(problem, "r") as f:
+        edge_labels = f["edge_labels"][:]
+        probs = f["probs"][:]
+    np.testing.assert_array_equal(edge_labels, labels)
+    # the RF must separate the toy problem nearly perfectly
+    acc = ((probs > 0.5).astype("int8") == labels).mean()
+    assert acc > 0.95
+    with open(rf_path, "rb") as f:
+        assert pickle.load(f).n_estimators == 100
+
+
+def test_morphology_workflow(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.morphology import MorphologyWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    seg, _ = _seg_and_data()
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = int(seg.max())
+
+    wf = MorphologyWorkflow(
+        input_path=path, input_key="seg", output_path=path,
+        output_key="morphology", tmp_folder=tmp_folder,
+        config_dir=config_dir, max_jobs=2, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        morpho = f["morphology"][:]
+    for lbl in (1, 2, 3):
+        m = seg == lbl
+        coords = np.stack(np.nonzero(m), 1)
+        assert morpho[lbl, 1] == m.sum()
+        np.testing.assert_allclose(morpho[lbl, 2:5], coords.mean(0),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(morpho[lbl, 5:8], coords.min(0))
+        np.testing.assert_array_equal(morpho[lbl, 8:11], coords.max(0))
+
+
+def test_region_centers(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.morphology import (MorphologyWorkflow,
+                                                        RegionCenters)
+
+    tmp_folder, config_dir = tmp_workdir
+    seg, _ = _seg_and_data()
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = int(seg.max())
+
+    morpho = MorphologyWorkflow(
+        input_path=path, input_key="seg", output_path=path,
+        output_key="morphology", tmp_folder=tmp_folder,
+        config_dir=config_dir, max_jobs=2, target="threads")
+    centers = RegionCenters(
+        input_path=path, input_key="seg", morphology_path=path,
+        morphology_key="morphology", output_path=path, output_key="centers",
+        n_labels=4, dependency=morpho, tmp_folder=tmp_folder,
+        config_dir=config_dir, max_jobs=1, target="threads")
+    assert build([centers], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        out = f["centers"][:]
+    # centers lie inside their own segment (the point of EDT centers)
+    for lbl in (1, 2, 3):
+        c = out[lbl].astype("int64")
+        assert seg[tuple(c)] == lbl
+
+
+def test_skeleton_workflow(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.skeletons import (SkeletonWorkflow,
+                                                       load_skeleton)
+
+    tmp_folder, config_dir = tmp_workdir
+    # a thick bar: its skeleton must run along the bar axis
+    seg = np.zeros((8, 8, 24), "uint64")
+    seg[2:6, 2:6, 2:22] = 1
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = 1
+
+    wf = SkeletonWorkflow(
+        input_path=path, input_key="seg", output_path=path,
+        output_key="skeletons", tmp_folder=tmp_folder,
+        config_dir=config_dir, max_jobs=1, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    coords = load_skeleton(path, "skeletons", 1)
+    assert coords is not None and len(coords) > 5
+    # every skeleton voxel lies inside the object
+    assert (seg[tuple(coords.T.astype("int64"))] == 1).all()
+    # the skeleton spans most of the bar length
+    assert coords[:, 2].max() - coords[:, 2].min() > 10
+
+
+def test_skeleton_evaluation(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.skeletons import (SkeletonEvaluation,
+                                                       SkeletonWorkflow)
+
+    tmp_folder, config_dir = tmp_workdir
+    seg = np.zeros((8, 8, 24), "uint64")
+    seg[2:6, 2:6, 2:22] = 1
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = 1
+        # a perfect segmentation of the same object
+        f.create_dataset("gt_seg", data=seg, chunks=[8, 8, 8])
+
+    wf = SkeletonWorkflow(
+        input_path=path, input_key="seg", output_path=path,
+        output_key="skeletons", tmp_folder=tmp_folder,
+        config_dir=config_dir, max_jobs=1, target="threads")
+    out_json = str(tmp_path / "eval.json")
+    ev = SkeletonEvaluation(
+        skeleton_path=path, skeleton_key="skeletons", seg_path=path,
+        seg_key="gt_seg", n_labels=2, output_path=out_json, dependency=wf,
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=1,
+        target="threads")
+    assert build([ev], raise_on_failure=True)
+    with open(out_json) as f:
+        result = json.load(f)
+    assert result["mean_correctness"] == 1.0
+    assert result["n_false_merges"] == 0
+
+
+def test_filter_by_threshold_workflow(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.postprocess import (
+        FilterByThresholdWorkflow)
+
+    tmp_folder, config_dir = tmp_workdir
+    seg, _ = _seg_and_data()
+    # intensity: bright segments 1/3, dark segment 2
+    data = np.where((seg == 1) | (seg == 3), 0.9, 0.1).astype("float32")
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("data", data=data, chunks=[8, 8, 8])
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = int(seg.max())
+
+    wf = FilterByThresholdWorkflow(
+        input_path=path, input_key="data", seg_in_path=path,
+        seg_in_key="seg", seg_out_path=path, seg_out_key="filtered",
+        threshold=0.5, tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads", relabel=False)
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        out = f["filtered"][:]
+    # dark segment 2 (mean 0.1 < 0.5) filtered to background
+    assert (out[seg == 2] == 0).all()
+    assert (out[seg == 1] == 1).all()
+    assert (out[seg == 3] == 3).all()
