@@ -1,7 +1,14 @@
 """User-facing workflow re-exports (reference: cluster_tools/__init__.py)."""
 
+from .affinities import InsertAffinities, SmoothedGradients
+from .copy_volume import CopyVolumeTask
+from .debugging import CheckComponents, CheckSubGraphs
+from .decomposition import DecompositionWorkflow
+from .downscaling import DownscalingWorkflow
 from .graph import GraphWorkflow
 from .inference import InferenceTask
+from .masking import BlocksFromMask, MinFilterMask
+from .paintera import BigcatWorkflow, PainteraConversionWorkflow
 from .multicut import MulticutWorkflow
 from .mutex_watershed import MwsWorkflow, TwoPassMwsWorkflow
 from .postprocess import (ConnectedComponentsWorkflow, FilterLabelsWorkflow,
@@ -26,6 +33,10 @@ from .watershed import (AgglomerateTask, WatershedFromSeedsTask,
                         WatershedWorkflow)
 
 __all__ = [
+    "BigcatWorkflow", "BlocksFromMask", "CheckComponents", "CheckSubGraphs",
+    "CopyVolumeTask", "DecompositionWorkflow", "DownscalingWorkflow",
+    "InsertAffinities", "MinFilterMask", "PainteraConversionWorkflow",
+    "SmoothedGradients",
     "AgglomerateTask", "AgglomerativeClusteringWorkflow",
     "ConnectedComponentsWorkflow", "FilterLabelsWorkflow",
     "FilterByThresholdWorkflow",

@@ -1,0 +1,212 @@
+"""Derived pixel maps: affinities from labels, object insertion, embedding
+distances, smoothed gradients.
+
+Re-specification of the reference's ``affinities/`` package
+(insert_affinities.py:159-213 — paste object-derived affinities into a
+predicted affinity map; embedding_distances.py:139-165 — affinities from
+pixel embeddings; gradients.py:131-176 — smoothed gradient maps).  The
+affinity computation (affogato compute_affinities equivalent) is a jitted
+shifted-equality over the offset channels — pure device work."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..core.blocking import Blocking
+from ..core.runtime import BlockTask
+from ..core.storage import file_reader
+
+
+def compute_affinities(labels: np.ndarray,
+                       offsets: Sequence[Sequence[int]]) -> np.ndarray:
+    """(C, *shape) float32 affinities: channel c is 1 where voxel i and
+    voxel i + offsets[c] carry the same nonzero label (affogato
+    compute_affinities equivalent, device compute)."""
+    import jax.numpy as jnp
+
+    from ..ops.rag import densify_labels
+
+    _, dense = densify_labels(np.asarray(labels))
+    x = jnp.asarray(dense)
+    out = []
+    for off in offsets:
+        shifted = x
+        valid = jnp.ones_like(x, dtype=bool)
+        for ax, o in enumerate(off):
+            shifted = jnp.roll(shifted, -o, axis=ax)
+            idx = jnp.arange(x.shape[ax])
+            ok = (idx + o >= 0) & (idx + o < x.shape[ax])
+            shape = [1] * x.ndim
+            shape[ax] = -1
+            valid = valid & ok.reshape(shape)
+        aff = (x == shifted) & (x > 0) & valid
+        out.append(aff)
+    return np.asarray(jnp.stack(out).astype(jnp.float32))
+
+
+def embedding_distance_affinities(embeddings: np.ndarray,
+                                  offsets: Sequence[Sequence[int]],
+                                  norm: str = "l2") -> np.ndarray:
+    """(C, *shape) affinities from pixel embeddings (E, *shape): channel c =
+    exp(-||e_i - e_{i+off}||) (reference: embedding_distances.py:139-165)."""
+    import jax.numpy as jnp
+
+    e = jnp.asarray(embeddings.astype("float32"))
+    out = []
+    for off in offsets:
+        shifted = e
+        for ax, o in enumerate(off):
+            shifted = jnp.roll(shifted, -o, axis=ax + 1)
+        if norm == "l2":
+            d = jnp.sqrt(((e - shifted) ** 2).sum(axis=0))
+        elif norm == "cosine":
+            num = (e * shifted).sum(axis=0)
+            den = jnp.maximum(
+                jnp.linalg.norm(e, axis=0) * jnp.linalg.norm(shifted, axis=0),
+                1e-6)
+            d = 1.0 - num / den
+        else:
+            raise ValueError(f"unknown norm {norm}")
+        out.append(jnp.exp(-d))
+    return np.asarray(jnp.stack(out))
+
+
+class InsertAffinities(BlockTask):
+    """Paste object-derived affinities into a predicted affinity map
+    (reference: insert_affinities.py:159-213): where dilated objects exist,
+    affinities become the max of prediction and object affinity."""
+
+    task_name = "insert_affinities"
+
+    def __init__(self, input_path: str, input_key: str, objects_path: str,
+                 objects_key: str, output_path: str, output_key: str,
+                 offsets: Sequence[Sequence[int]], **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.objects_path = objects_path
+        self.objects_key = objects_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.offsets = [list(o) for o in offsets]
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"dilate_by": 2})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            in_shape = list(f[self.input_key].shape)
+        assert len(in_shape) == 4
+        shape = in_shape[1:]
+        block_shape = self.global_block_shape()[-3:]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=in_shape,
+                              chunks=[1] + block_shape, dtype="float32")
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "objects_path": self.objects_path,
+            "objects_key": self.objects_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "offsets": self.offsets,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from scipy.ndimage import binary_dilation
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        offsets = cfg["offsets"]
+        halo = np.abs(np.asarray(offsets)).max(axis=0).tolist()
+        dilate_by = int(cfg.get("dilate_by", 2))
+        halo = [h + dilate_by for h in halo]
+        f_in = file_reader(cfg["input_path"], "r")
+        f_obj = file_reader(cfg["objects_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in = f_in[cfg["input_key"]]
+        ds_obj = f_obj[cfg["objects_key"]]
+        ds_out = f_out[cfg["output_key"]]
+
+        for block_id in job_config["block_list"]:
+            bh = blocking.get_block_with_halo(block_id, halo)
+            inner = (slice(None),) + bh.inner.bb
+            local = (slice(None),) + bh.inner_local.bb
+            objs = np.asarray(ds_obj[bh.outer.bb])
+            if not objs.any():
+                ds_out[inner] = np.asarray(ds_in[inner])
+                log_fn(f"processed block {block_id}")
+                continue
+            affs = np.asarray(
+                ds_in[(slice(None),) + bh.outer.bb]).astype("float32")
+            if dilate_by > 0:
+                grown = binary_dilation(objs > 0, iterations=dilate_by)
+                # grow object ids into the dilated ring (nearest label via
+                # one graph-watershed-free trick: keep original ids, dilated
+                # ring gets the id of the nearest object voxel along axes)
+                from scipy.ndimage import distance_transform_edt
+
+                _, idx = distance_transform_edt(objs == 0,
+                                                return_indices=True)
+                objs = np.where(grown, objs[tuple(idx)], objs)
+            obj_affs = compute_affinities(objs, offsets)
+            affs = np.maximum(affs, obj_affs)
+            ds_out[inner] = affs[local]
+            log_fn(f"processed block {block_id}")
+
+
+class SmoothedGradients(BlockTask):
+    """Gaussian gradient-magnitude map (reference: gradients.py:131-176),
+    device filters (ops/filters)."""
+
+    task_name = "smoothed_gradients"
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, sigma: float = 2.0, **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.sigma = sigma
+        super().__init__(**kw)
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            shape = list(f[self.input_key].shape)
+        block_shape = self.global_block_shape()[-len(shape):]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=shape,
+                              chunks=block_shape, dtype="float32")
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "sigma": self.sigma,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        import jax.numpy as jnp
+
+        from ..ops.filters import gaussian_gradient_magnitude
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        sigma = cfg["sigma"]
+        halo = [int(4 * sigma + 1)] * blocking.ndim
+        f_in = file_reader(cfg["input_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
+        for block_id in job_config["block_list"]:
+            bh = blocking.get_block_with_halo(block_id, halo)
+            x = np.asarray(ds_in[bh.outer.bb]).astype("float32")
+            g = np.asarray(gaussian_gradient_magnitude(jnp.asarray(x), sigma))
+            ds_out[bh.inner.bb] = g[bh.inner_local.bb]
+            log_fn(f"processed block {block_id}")

@@ -1,0 +1,143 @@
+"""Sanity-check workflows (the reference's post-hoc "sanitizers",
+SURVEY §5.2).
+
+Re-specification of ``debugging/``: verify per-block sub-graph node sets
+match the watershed uniques (check_sub_graphs.py:83-101), verify segments
+are actually connected by re-running connected components per label
+(check_components.py:85-117)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..core.blocking import Blocking
+from ..core.runtime import BlockTask
+from ..core.storage import file_reader
+
+
+class CheckSubGraphs(BlockTask):
+    """Per block: nodes stored in the sub-graph == np.unique(watershed)
+    (reference: check_sub_graphs.py:83-101).  Failing block ids are
+    written to ``<tmp_folder>/check_sub_graphs_failed.json``."""
+
+    task_name = "check_sub_graphs"
+
+    def __init__(self, ws_path: str, ws_key: str, graph_path: str, **kw):
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.graph_path = graph_path
+        super().__init__(**kw)
+
+    def run_impl(self):
+        with file_reader(self.ws_path, "r") as f:
+            shape = list(f[self.ws_key].shape)
+        block_shape = self.global_block_shape()[-len(shape):]
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "ws_path": self.ws_path, "ws_key": self.ws_key,
+            "graph_path": self.graph_path,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=self.max_jobs)
+        # merge per-job failure lists
+        failed: List[int] = []
+        for name in os.listdir(self.tmp_folder):
+            if name.startswith("check_sub_graphs_failed_job"):
+                with open(os.path.join(self.tmp_folder, name)) as f:
+                    failed.extend(json.load(f))
+        out = os.path.join(self.tmp_folder, "check_sub_graphs_failed.json")
+        with open(out, "w") as f:
+            json.dump(sorted(failed), f)
+        if failed:
+            raise RuntimeError(
+                f"{len(failed)} blocks have inconsistent sub-graphs: "
+                f"{sorted(failed)[:20]} (full list at {out})")
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from ..core import graph as g
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        f = file_reader(cfg["ws_path"], "r")
+        ds = f[cfg["ws_key"]]
+        failed = []
+        for block_id in job_config["block_list"]:
+            block = blocking.get_block(block_id)
+            # this framework's sub-graphs include the +1 upper-face halo
+            # (the RAG pair-ownership convention, workflows/graph.py:72);
+            # check the invariant as constructed
+            end = [min(e + 1, s) for e, s in zip(block.end, cfg["shape"])]
+            bb = tuple(slice(b, e) for b, e in zip(block.begin, end))
+            seg = np.asarray(ds[bb])
+            nodes_seg = np.unique(seg)
+            nodes_seg = nodes_seg[nodes_seg != 0]
+            data = g.load_sub_graph(cfg["graph_path"], 0, block_id)
+            nodes = data["nodes"]
+            if len(nodes) != len(nodes_seg) or not np.array_equal(
+                    np.sort(nodes), nodes_seg):
+                failed.append(int(block_id))
+            log_fn(f"processed block {block_id}")
+        with open(os.path.join(
+                job_config["tmp_folder"],
+                f"check_sub_graphs_failed_job{job_id}.json"), "w") as fo:
+            json.dump(failed, fo)
+
+
+class CheckComponents(BlockTask):
+    """Verify every segment is spatially connected: re-run CC inside each
+    label's bounding box (reference: check_components.py:85-117), sharded
+    over label-id ranges using the morphology table."""
+
+    task_name = "check_components"
+    global_task = True
+    allow_retry = False
+
+    def __init__(self, seg_path: str, seg_key: str, morphology_path: str,
+                 morphology_key: str, n_labels: int, output_path: str, **kw):
+        self.seg_path = seg_path
+        self.seg_key = seg_key
+        self.morphology_path = morphology_path
+        self.morphology_key = morphology_key
+        self.n_labels = n_labels
+        self.output_path = output_path
+        super().__init__(**kw)
+
+    def run_impl(self):
+        self.run_jobs(None, {
+            "seg_path": self.seg_path, "seg_key": self.seg_key,
+            "morphology_path": self.morphology_path,
+            "morphology_key": self.morphology_key,
+            "n_labels": self.n_labels, "output_path": self.output_path,
+        })
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from scipy import ndimage
+
+        cfg = job_config["config"]
+        with file_reader(cfg["morphology_path"], "r") as f:
+            morpho = f[cfg["morphology_key"]][:]
+        sizes = morpho[:, 1]
+        bb_min = morpho[:, 5:8].astype("int64")
+        bb_max = morpho[:, 8:11].astype("int64") + 1
+        f = file_reader(cfg["seg_path"], "r")
+        ds = f[cfg["seg_key"]]
+        struct = np.ones((3, 3, 3), bool)
+        disconnected = []
+        for label_id in range(1, cfg["n_labels"]):
+            if sizes[label_id] == 0:
+                continue
+            bb = tuple(slice(b, e) for b, e in
+                       zip(bb_min[label_id], bb_max[label_id]))
+            obj = np.asarray(ds[bb]) == label_id
+            _, n_comp = ndimage.label(obj, structure=struct)
+            if n_comp != 1:
+                disconnected.append(int(label_id))
+        with open(cfg["output_path"], "w") as fo:
+            json.dump(disconnected, fo)
+        log_fn(f"{len(disconnected)} disconnected segments of "
+               f"{cfg['n_labels']}")

@@ -1,0 +1,285 @@
+"""Multiscale pyramid creation + up-scaling.
+
+Re-specification of the reference's ``downscaling/`` package
+(downscaling.py:232-311 ``_ds_block`` with vigra-resize / skimage
+block_reduce samplers, downscaling_workflow.py:33-349 incl. Paintera
+multiscale metadata, upscaling.py:206-257).  TPU-first: the samplers are
+jitted device programs — mean/max/min pooling as a reshape-reduce, label
+downsampling by nearest/mode, smooth interpolation via jax.image.resize
+(VPU work, fused by XLA); one compiled program per (shape, factor) pair.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.blocking import Blocking
+from ..core.runtime import BlockTask
+from ..core.storage import file_reader
+from ..core.workflow import FileTarget, Task
+
+ScaleFactor = Union[int, Sequence[int]]
+
+
+def _factor3(scale_factor: ScaleFactor) -> List[int]:
+    if isinstance(scale_factor, int):
+        return [scale_factor] * 3
+    return [int(s) for s in scale_factor]
+
+
+def downsample(x: np.ndarray, factor: Sequence[int],
+               sampler: str = "mean") -> np.ndarray:
+    """Downsample by integer factors (device compute).
+
+    samplers: 'mean' | 'max' | 'min' (pooling), 'nearest' (label-safe
+    subsampling), 'majority' (label-safe mode pooling), 'interpolate'
+    (linear resize — the vigra.sampling.resize analog).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    factor = list(factor)
+    # pad up to a multiple of the factor (edge replicate), pool, crop back
+    out_shape = tuple(-(-s // f) for s, f in zip(x.shape, factor))
+    pad = tuple((0, o * f - s) for s, f, o in zip(x.shape, factor, out_shape))
+
+    if sampler == "interpolate":
+        y = jax.image.resize(jnp.asarray(x.astype("float32")), out_shape,
+                             method="linear")
+        return np.asarray(y).astype(x.dtype if
+                                    np.issubdtype(x.dtype, np.floating)
+                                    else "float32")
+    if sampler == "nearest":
+        # subsample at the window centers — exact for label volumes
+        idx = tuple(np.minimum(np.arange(o) * f + f // 2, s - 1)
+                    for o, f, s in zip(out_shape, factor, x.shape))
+        return x[np.ix_(*idx)]
+    if sampler == "majority":
+        return _majority_pool(x, factor, out_shape)
+
+    red = {"mean": jnp.mean, "max": jnp.max, "min": jnp.min}[sampler]
+    xp = jnp.pad(jnp.asarray(x.astype("float32")), pad, mode="edge")
+    r = xp.reshape(out_shape[0], factor[0], out_shape[1], factor[1],
+                   out_shape[2], factor[2])
+    y = red(r, axis=(1, 3, 5))
+    y = np.asarray(y)
+    if np.issubdtype(x.dtype, np.integer):
+        info = np.iinfo(x.dtype)
+        y = np.clip(np.round(y), info.min, info.max)
+    return y.astype(x.dtype)
+
+
+def _majority_pool(x: np.ndarray, factor, out_shape) -> np.ndarray:
+    """Mode over each pooling window (label-safe downsampling)."""
+    pad = tuple((0, o * f - s) for s, f, o in zip(x.shape, factor, out_shape))
+    xp = np.pad(x, pad, mode="edge")
+    r = xp.reshape(out_shape[0], factor[0], out_shape[1], factor[1],
+                   out_shape[2], factor[2])
+    windows = r.transpose(0, 2, 4, 1, 3, 5).reshape(*out_shape, -1)
+    w = np.sort(windows, axis=-1)
+    # longest run in the sorted window = the mode
+    n = w.shape[-1]
+    best = w[..., 0].copy()
+    best_run = np.ones(out_shape, "int32")
+    run = np.ones(out_shape, "int32")
+    for k in range(1, n):
+        same = w[..., k] == w[..., k - 1]
+        run = np.where(same, run + 1, 1)
+        upd = run > best_run
+        best_run = np.where(upd, run, best_run)
+        best = np.where(upd, w[..., k], best)
+    return best.astype(x.dtype)
+
+
+def upsample(x: np.ndarray, factor: Sequence[int],
+             sampler: str = "nearest") -> np.ndarray:
+    """Upsample by integer factors (reference: upscaling.py:206-257)."""
+    import jax
+    import jax.numpy as jnp
+
+    out_shape = tuple(s * f for s, f in zip(x.shape, factor))
+    if sampler == "interpolate":
+        y = jax.image.resize(jnp.asarray(x.astype("float32")), out_shape,
+                             method="linear")
+        return np.asarray(y).astype(
+            x.dtype if np.issubdtype(x.dtype, np.floating) else "float32")
+    return np.repeat(np.repeat(np.repeat(x, factor[0], 0), factor[1], 1),
+                     factor[2], 2)
+
+
+class DownscaleTask(BlockTask):
+    """One pyramid level: blockwise downsample of the previous level
+    (reference: DownscalingBase, downscaling.py:31-140)."""
+
+    task_name = "downscaling"
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, scale_factor: ScaleFactor,
+                 sampler: Optional[str] = None, identifier: str = "", **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.scale_factor = _factor3(scale_factor)
+        #: constructor override of the config-tier sampler (label pyramids
+        #: must be nearest/majority regardless of the shared task config)
+        self.sampler = sampler
+        self.identifier = identifier
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"sampler": "mean"})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            in_shape = list(f[self.input_key].shape)
+        out_shape = [-(-s // f) for s, f in zip(in_shape, self.scale_factor)]
+        block_shape = [min(b, s) for b, s in
+                       zip(self.global_block_shape(), out_shape)]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=out_shape,
+                              chunks=block_shape,
+                              dtype=str(f_dtype(self.input_path,
+                                                self.input_key)))
+        block_list = self.blocks_in_volume(out_shape, block_shape)
+        extra = {} if self.sampler is None else {"sampler": self.sampler}
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "scale_factor": self.scale_factor,
+            "shape": out_shape, "block_shape": block_shape,
+            "in_shape": in_shape, **extra,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        factor = cfg["scale_factor"]
+        f_in = file_reader(cfg["input_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
+        sampler = cfg.get("sampler", "mean")
+
+        for block_id in job_config["block_list"]:
+            block = blocking.get_block(block_id)
+            in_bb = tuple(slice(b.start * f, min(b.stop * f, s))
+                          for b, f, s in zip(block.bb, factor,
+                                             cfg["in_shape"]))
+            x = np.asarray(ds_in[in_bb])
+            if not x.any():
+                log_fn(f"processed block {block_id}")
+                continue
+            y = downsample(x, factor, sampler)
+            ds_out[block.bb] = y[tuple(slice(0, b.stop - b.start)
+                                       for b in block.bb)]
+            log_fn(f"processed block {block_id}")
+
+
+def f_dtype(path: str, key: str):
+    with file_reader(path, "r") as f:
+        return f[key].dtype
+
+
+class WriteDownscalingMetadata(Task):
+    """Multiscale metadata: per-level downsamplingFactors + group attrs
+    (reference: downscaling_workflow.py:33-215, paintera format)."""
+
+    def __init__(self, tmp_folder: str, output_path: str, scale_factors,
+                 output_key_prefix: str = "", metadata_dict=None,
+                 scale_offset: int = 0, dependency: Optional[Task] = None):
+        self.tmp_folder = tmp_folder
+        self.output_path = output_path
+        self.scale_factors = [_factor3(s) for s in scale_factors]
+        self.output_key_prefix = output_key_prefix
+        self.metadata_dict = dict(metadata_dict or {})
+        self.scale_offset = scale_offset
+        self.dependency = dependency
+        super().__init__()
+
+    def requires(self):
+        return self.dependency
+
+    def run(self):
+        effective = [1, 1, 1]
+        with file_reader(self.output_path) as f:
+            for scale, factor in enumerate(self.scale_factors):
+                key = os.path.join(self.output_key_prefix,
+                                   f"s{scale + self.scale_offset + 1}")
+                effective = [e * s for e, s in zip(effective, factor)]
+                # paintera axis order is XYZ; ours is ZYX -> reverse
+                f[key].attrs["downsamplingFactors"] = effective[::-1]
+            group = (f.require_group(self.output_key_prefix)
+                     if self.output_key_prefix else f)
+            group.attrs["multiScale"] = True
+            group.attrs["resolution"] = list(
+                self.metadata_dict.get("resolution", [1.0] * 3))[::-1]
+            group.attrs["offset"] = list(
+                self.metadata_dict.get("offsets", [0.0] * 3))[::-1]
+            # propagate maxId from level 0 if present
+            level0 = os.path.join(self.output_key_prefix,
+                                  f"s{self.scale_offset}")
+            max_id = f[level0].attrs.get("maxId")
+            if max_id is not None:
+                group.attrs["maxId"] = int(max_id)
+        self.output().touch()
+
+    def output(self):
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "downscaling_metadata.status"))
+
+
+class DownscalingWorkflow(Task):
+    """Chain of DownscaleTasks (s1..sN from s0) + metadata (reference:
+    DownscalingWorkflow, downscaling_workflow.py:218-349; existing scale
+    datasets are skipped by the tasks' status targets)."""
+
+    def __init__(self, input_path: str, input_key: str,
+                 scale_factors: Sequence[ScaleFactor], tmp_folder: str,
+                 config_dir: str, max_jobs: int = 1, target: str = "local",
+                 output_key_prefix: str = "", metadata_dict=None,
+                 dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.scale_factors = list(scale_factors)
+        self.output_key_prefix = output_key_prefix
+        self.metadata_dict = metadata_dict or {}
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def _scale_key(self, scale: int) -> str:
+        if scale == 0:
+            return self.input_key
+        return os.path.join(self.output_key_prefix, f"s{scale}")
+
+    def requires(self):
+        common = dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                      max_jobs=self.max_jobs, target=self.target)
+        dep = self.dependency
+        for scale, factor in enumerate(self.scale_factors):
+            dep = DownscaleTask(
+                input_path=self.input_path,
+                input_key=self._scale_key(scale),
+                output_path=self.input_path,
+                output_key=self._scale_key(scale + 1),
+                scale_factor=factor, identifier=f"s{scale + 1}",
+                dependency=dep, **common)
+        return WriteDownscalingMetadata(
+            tmp_folder=self.tmp_folder, output_path=self.input_path,
+            scale_factors=self.scale_factors,
+            output_key_prefix=self.output_key_prefix,
+            metadata_dict=self.metadata_dict, dependency=dep)
+
+    def output(self):
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "downscaling_metadata.status"))

@@ -167,8 +167,7 @@ class RFPredict(BlockTask):
             f.require_dataset(self.output_key, shape=(max(n_edges, 1),),
                               chunks=(min(chunk, max(n_edges, 1)),),
                               dtype="float32")
-        n_chunks = (n_edges + chunk - 1) // chunk or 1
-        self.run_jobs(list(range(n_chunks)), {
+        self.run_jobs(self.id_chunks(n_edges, chunk), {
             "rf_path": self.rf_path, "features_path": self.features_path,
             "features_key": self.features_key,
             "output_path": self.output_path, "output_key": self.output_key,
@@ -260,29 +259,11 @@ class LearningWorkflow(Task):
             deps.append(edge_labels)
             features_dict[name] = (problem, "features")
             labels_dict[name] = (problem, "edge_labels")
-        gather = DummyGather(dependencies=deps, tmp_folder=self.tmp_folder)
+        # Task.requires handles iterable dependencies: direct fan-in
         return LearnRF(features_dict=features_dict, labels_dict=labels_dict,
-                       output_path=self.output_path, dependency=gather,
+                       output_path=self.output_path, dependency=deps,
                        **common)
 
     def output(self):
         return FileTarget(os.path.join(self.tmp_folder, "learn_rf.status"))
 
-
-class DummyGather(Task):
-    """Fan-in node: complete when all dependencies are."""
-
-    def __init__(self, dependencies, tmp_folder: str):
-        self.dependencies = list(dependencies)
-        self.tmp_folder = tmp_folder
-        super().__init__()
-
-    def requires(self):
-        return self.dependencies
-
-    def run(self):
-        with open(self.output().path, "w") as f:
-            f.write("done")
-
-    def output(self):
-        return FileTarget(os.path.join(self.tmp_folder, "gather.status"))

@@ -197,8 +197,7 @@ class CostsFromNodeLabels(BlockTask):
             f.require_dataset(self.output_key, shape=(max(n_lifted, 1),),
                               chunks=(min(chunk_size, max(n_lifted, 1)),),
                               dtype="float64")
-        n_chunks = max((n_lifted + chunk_size - 1) // chunk_size, 1)
-        self.run_jobs(list(range(n_chunks)), {
+        self.run_jobs(self.id_chunks(n_lifted, chunk_size), {
             "nh_path": self.nh_path, "nh_key": self.nh_key,
             "node_label_path": self.node_label_path,
             "node_label_key": self.node_label_key,

@@ -140,21 +140,14 @@ class MergeMorphology(BlockTask):
         return conf
 
     def run_impl(self):
-        from ..core.storage import read_max_id
-
-        if self.n_labels is None:
-            # resolved at RUN time, after upstream tasks have produced the
-            # labels volume (requires() runs at DAG-construction time)
-            self.n_labels = read_max_id(self.labels_path,
-                                        self.labels_key) + 1
+        self.resolve_n_labels()
         chunk = int(self.task_config.get("id_chunk_size", 1e6))
         n = max(self.n_labels, 1)
         with file_reader(self.output_path) as f:
             f.require_dataset(self.output_key, shape=(n, N_COLS),
                               chunks=(min(chunk, n), N_COLS),
                               dtype="float64")
-        n_chunks = (self.n_labels + chunk - 1) // chunk or 1
-        self.run_jobs(list(range(n_chunks)), {
+        self.run_jobs(self.id_chunks(self.n_labels, chunk), {
             "output_path": self.output_path, "output_key": self.output_key,
             "n_labels": self.n_labels, "id_chunk_size": chunk,
             "prefix": self.prefix,
@@ -224,8 +217,7 @@ class RegionCenters(BlockTask):
         with file_reader(self.output_path) as f:
             f.require_dataset(self.output_key, shape=(n, 3),
                               chunks=(min(chunk, n), 3), dtype="float32")
-        n_chunks = (self.n_labels + chunk - 1) // chunk or 1
-        self.run_jobs(list(range(n_chunks)), {
+        self.run_jobs(self.id_chunks(self.n_labels, chunk), {
             "input_path": self.input_path, "input_key": self.input_key,
             "morphology_path": self.morphology_path,
             "morphology_key": self.morphology_key,
@@ -241,11 +233,8 @@ class RegionCenters(BlockTask):
         cfg = job_config["config"]
         chunk, n_labels = cfg["id_chunk_size"], cfg["n_labels"]
         resolution = cfg.get("resolution") or [1, 1, 1]
-        with file_reader(cfg["morphology_path"], "r") as f:
-            morpho = f[cfg["morphology_key"]][:]
-        sizes = morpho[:, 1]
-        bb_min = morpho[:, 5:8].astype("int64")
-        bb_max = morpho[:, 8:11].astype("int64") + 1
+        f_morph = file_reader(cfg["morphology_path"], "r")
+        ds_morph = f_morph[cfg["morphology_key"]]
         f_in = file_reader(cfg["input_path"], "r")
         f_out = file_reader(cfg["output_path"])
         ds_in = f_in[cfg["input_key"]]
@@ -254,12 +243,19 @@ class RegionCenters(BlockTask):
 
         for block_id in job_config["block_list"]:
             lo, hi = block_id * chunk, min((block_id + 1) * chunk, n_labels)
+            # chunk-aligned read of only the owned id range (the table can
+            # be GBs at cluster scale; never load it whole per job)
+            morpho = ds_morph[lo:hi, :]
+            sizes = morpho[:, 1]
+            bb_min = morpho[:, 5:8].astype("int64")
+            bb_max = morpho[:, 8:11].astype("int64") + 1
             centers = np.zeros((hi - lo, 3), "float32")
             for label_id in range(lo, hi):
-                if label_id == ignore or sizes[label_id] == 0:
+                if label_id == ignore or sizes[label_id - lo] == 0:
                     continue
                 bb = tuple(slice(b, e) for b, e in
-                           zip(bb_min[label_id], bb_max[label_id]))
+                           zip(bb_min[label_id - lo],
+                               bb_max[label_id - lo]))
                 obj = np.asarray(ds_in[bb]) == label_id
                 if not obj.any():
                     continue

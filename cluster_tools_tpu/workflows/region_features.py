@@ -130,13 +130,7 @@ class MergeRegionFeatures(BlockTask):
         return conf
 
     def run_impl(self):
-        from ..core.storage import read_max_id
-
-        if self.n_labels is None:
-            # resolved at RUN time, after upstream tasks have produced the
-            # labels volume (requires() runs at DAG-construction time)
-            self.n_labels = read_max_id(self.labels_path,
-                                        self.labels_key) + 1
+        self.resolve_n_labels()
         chunk = int(self.task_config.get("id_chunk_size", 1e6))
         n = max(self.n_labels, 1)
         with file_reader(self.output_path) as f:
@@ -144,8 +138,7 @@ class MergeRegionFeatures(BlockTask):
                               chunks=(min(chunk, n),), dtype="float32")
             f.require_dataset(self.output_key + "_counts", shape=(n,),
                               chunks=(min(chunk, n),), dtype="float32")
-        n_chunks = (self.n_labels + chunk - 1) // chunk or 1
-        self.run_jobs(list(range(n_chunks)), {
+        self.run_jobs(self.id_chunks(self.n_labels, chunk), {
             "output_path": self.output_path, "output_key": self.output_key,
             "n_labels": self.n_labels, "id_chunk_size": chunk,
             "prefix": self.prefix,

@@ -56,14 +56,9 @@ class Skeletonize(BlockTask):
         return conf
 
     def run_impl(self):
-        from ..core.storage import read_max_id
-
-        if self.n_labels is None:
-            self.n_labels = read_max_id(self.input_path,
-                                        self.input_key) + 1
+        self.resolve_n_labels(self.input_path, self.input_key)
         chunk = int(self.task_config.get("id_chunk_size", 1000))
-        n_chunks = (self.n_labels + chunk - 1) // chunk or 1
-        self.run_jobs(list(range(n_chunks)), {
+        self.run_jobs(self.id_chunks(self.n_labels, chunk), {
             "input_path": self.input_path, "input_key": self.input_key,
             "morphology_path": self.morphology_path,
             "morphology_key": self.morphology_key,
@@ -76,11 +71,8 @@ class Skeletonize(BlockTask):
         cfg = job_config["config"]
         chunk, n_labels = cfg["id_chunk_size"], cfg["n_labels"]
         size_threshold = cfg.get("size_threshold", 0)
-        with file_reader(cfg["morphology_path"], "r") as f:
-            morpho = f[cfg["morphology_key"]][:]
-        sizes = morpho[:, 1]
-        bb_min = morpho[:, 5:8].astype("int64")
-        bb_max = morpho[:, 8:11].astype("int64") + 1
+        f_morph = file_reader(cfg["morphology_path"], "r")
+        ds_morph = f_morph[cfg["morphology_key"]]
         f_in = file_reader(cfg["input_path"], "r")
         ds_in = f_in[cfg["input_key"]]
         out = VarlenDataset(os.path.join(cfg["output_path"],
@@ -88,12 +80,19 @@ class Skeletonize(BlockTask):
 
         for block_id in job_config["block_list"]:
             lo, hi = block_id * chunk, min((block_id + 1) * chunk, n_labels)
+            # chunk-aligned read of only the owned id range
+            morpho = ds_morph[lo:hi, :]
+            sizes = morpho[:, 1]
+            bb_min = morpho[:, 5:8].astype("int64")
+            bb_max = morpho[:, 8:11].astype("int64") + 1
             for label_id in range(max(lo, 1), hi):  # 0 = ignore label
-                if sizes[label_id] == 0 or (
-                        size_threshold and sizes[label_id] < size_threshold):
+                if sizes[label_id - lo] == 0 or (
+                        size_threshold
+                        and sizes[label_id - lo] < size_threshold):
                     continue
                 bb = tuple(slice(b, e) for b, e in
-                           zip(bb_min[label_id], bb_max[label_id]))
+                           zip(bb_min[label_id - lo],
+                               bb_max[label_id - lo]))
                 obj = np.asarray(ds_in[bb]) == label_id
                 if not obj.any():
                     continue

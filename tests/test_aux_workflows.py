@@ -1,0 +1,195 @@
+"""Masking, debugging, affinities, decomposition-multicut tests."""
+
+import json
+import os
+
+import numpy as np
+
+from cluster_tools_tpu.core.storage import file_reader
+from cluster_tools_tpu.core.workflow import build
+
+
+def test_compute_affinities_oracle():
+    from cluster_tools_tpu.workflows.affinities import compute_affinities
+
+    labels = np.zeros((4, 4, 4), "uint64")
+    labels[:, :2, :] = 1
+    labels[:, 2:, :] = 2
+    offsets = [[0, -1, 0], [0, 0, -1]]
+    affs = compute_affinities(labels, offsets)
+    assert affs.shape == (2, 4, 4, 4)
+    # along x (same label): 1 wherever valid
+    assert (affs[1, :, :, 1:] == 1).all()
+    # along y: 0 at the 1|2 boundary (voxel at y=2 has neighbor y=1 in 1)
+    assert (affs[0, :, 2, :] == 0).all()
+    assert (affs[0, :, 3, :] == 1).all()
+
+
+def test_embedding_distance_affinities():
+    from cluster_tools_tpu.workflows.affinities import (
+        embedding_distance_affinities)
+
+    emb = np.zeros((2, 4, 4, 4), "float32")
+    emb[0, :, 2:, :] = 10.0  # two well-separated clusters along y
+    affs = embedding_distance_affinities(emb, [[0, -1, 0]])
+    # within-cluster: distance 0 -> affinity 1; across: exp(-10) ~ 0
+    assert affs[0, 0, 3, 0] > 0.99
+    assert affs[0, 0, 2, 0] < 0.01
+
+
+def test_blocks_from_mask(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.masking import BlocksFromMask
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (20, 20, 20)
+    mask = np.zeros(shape, "uint8")
+    mask[:, :10, :] = 1  # only the y<10 half
+    path = str(tmp_path / "m.n5")
+    with file_reader(path) as f:
+        f.create_dataset("mask", data=mask, chunks=[10, 10, 10])
+
+    out = str(tmp_path / "blocks.json")
+    task = BlocksFromMask(
+        mask_path=path, mask_key="mask", shape=shape,
+        block_shape=[10, 10, 10], output_path=out, tmp_folder=tmp_folder)
+    assert build([task], raise_on_failure=True)
+    with open(out) as f:
+        blocks = json.load(f)
+    assert len(blocks) == 4  # half of the 8 blocks
+
+
+def test_minfilter_mask(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.masking import MinFilterMask
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 16, 16)
+    mask = np.ones(shape, "uint8")
+    mask[8, 8, 8] = 0
+    path = str(tmp_path / "m.n5")
+    with file_reader(path) as f:
+        f.create_dataset("mask", data=mask, chunks=[8, 8, 8])
+
+    task = MinFilterMask(
+        input_path=path, input_key="mask", output_path=path,
+        output_key="shrunk", filter_shape=[3, 3, 3],
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([task], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        out = f["shrunk"][:]
+    # the zero hole grows to its 3x3x3 neighborhood
+    assert (out[7:10, 7:10, 7:10] == 0).all()
+    assert out[5, 5, 5] == 1
+
+
+def test_check_sub_graphs(tmp_workdir, tmp_path):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.workflows.debugging import CheckSubGraphs
+    from cluster_tools_tpu.workflows.graph import GraphWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (20, 20, 20)
+    seg = np.ones(shape, "uint64")
+    seg[:, 10:, :] = 2
+    path = str(tmp_path / "d.n5")
+    problem = str(tmp_path / "p.n5")
+    with file_reader(path) as f:
+        f.create_dataset("ws", data=seg, chunks=[10, 10, 10])
+
+    common = dict(tmp_folder=tmp_folder, config_dir=config_dir,
+                  max_jobs=2, target="threads")
+    graph = GraphWorkflow(input_path=path, input_key="ws",
+                          graph_path=problem, output_key="s0/graph",
+                          **common)
+    check = CheckSubGraphs(ws_path=path, ws_key="ws", graph_path=problem,
+                           dependency=graph, **common)
+    assert ctt.build([check], raise_on_failure=True)
+    with open(os.path.join(tmp_folder, "check_sub_graphs_failed.json")) as f:
+        assert json.load(f) == []
+
+
+def test_check_components(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.debugging import CheckComponents
+    from cluster_tools_tpu.workflows.morphology import MorphologyWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 16, 16)
+    seg = np.zeros(shape, "uint64")
+    seg[:4] = 1
+    # label 2 is disconnected: two separate slabs
+    seg[6:8] = 2
+    seg[10:12] = 2
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = 2
+
+    common = dict(tmp_folder=tmp_folder, config_dir=config_dir,
+                  max_jobs=1, target="threads")
+    morpho = MorphologyWorkflow(
+        input_path=path, input_key="seg", output_path=path,
+        output_key="morphology", **common)
+    out_json = str(tmp_path / "disconnected.json")
+    check = CheckComponents(
+        seg_path=path, seg_key="seg", morphology_path=path,
+        morphology_key="morphology", n_labels=3, output_path=out_json,
+        dependency=morpho, **common)
+    assert build([check], raise_on_failure=True)
+    with open(out_json) as f:
+        assert json.load(f) == [2]
+
+
+def test_decomposition_workflow(tmp_workdir, tmp_path):
+    """Decomposition solver recovers the truth on the synthetic instance."""
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.workflows.decomposition import (
+        DecompositionWorkflow)
+    from cluster_tools_tpu.workflows.segmentation import ProblemWorkflow
+    from tests.test_multicut import (_boundary_map, _check_recovery,
+                                     _nested_voronoi)
+
+    tmp_folder, config_dir = tmp_workdir
+    true, frags = _nested_voronoi()
+    bnd = _boundary_map(true)
+    path = str(tmp_path / "d.n5")
+    problem = str(tmp_path / "p.n5")
+    with file_reader(path) as f:
+        f.create_dataset("bmap", data=bnd, chunks=(12, 12, 12))
+        f.create_dataset("ws", data=frags, chunks=(12, 12, 12))
+
+    common = dict(tmp_folder=tmp_folder, config_dir=config_dir,
+                  max_jobs=2, target="threads")
+    prob = ProblemWorkflow(
+        input_path=path, input_key="bmap", ws_path=path, ws_key="ws",
+        problem_path=problem, **common)
+    wf = DecompositionWorkflow(
+        problem_path=problem, ws_path=path, ws_key="ws",
+        output_path=path, output_key="seg", dependency=prob, **common)
+    assert ctt.build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        seg = f["seg"][:]
+    _check_recovery(true, seg)
+
+
+def test_smoothed_gradients(tmp_workdir, tmp_path):
+    from scipy import ndimage
+
+    from cluster_tools_tpu.workflows.affinities import SmoothedGradients
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 16, 16)
+    vol = np.random.RandomState(0).rand(*shape).astype("float32")
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("raw", data=vol, chunks=[8, 8, 8])
+
+    task = SmoothedGradients(
+        input_path=path, input_key="raw", output_path=path,
+        output_key="grad", sigma=1.5, tmp_folder=tmp_folder,
+        config_dir=config_dir, max_jobs=2, target="threads")
+    assert build([task], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        out = f["grad"][:]
+    ref = ndimage.gaussian_gradient_magnitude(vol, 1.5, mode="reflect")
+    assert np.abs(out - ref).max() < 0.05

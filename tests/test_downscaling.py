@@ -1,0 +1,186 @@
+"""Downscaling pyramid, copy_volume, paintera conversion tests."""
+
+import numpy as np
+
+from cluster_tools_tpu.core.storage import file_reader
+from cluster_tools_tpu.core.workflow import build
+
+
+def test_downsample_samplers():
+    from cluster_tools_tpu.workflows.downscaling import downsample, upsample
+
+    x = np.arange(4 * 4 * 4, dtype="float32").reshape(4, 4, 4)
+    mean = downsample(x, [2, 2, 2], "mean")
+    assert mean.shape == (2, 2, 2)
+    np.testing.assert_allclose(mean[0, 0, 0], x[:2, :2, :2].mean())
+    mx = downsample(x, [2, 2, 2], "max")
+    np.testing.assert_allclose(mx[0, 0, 0], x[:2, :2, :2].max())
+
+    labels = np.zeros((4, 4, 4), "uint64")
+    labels[:, :, 2:] = 7
+    near = downsample(labels, [2, 2, 2], "nearest")
+    assert set(np.unique(near)) <= {0, 7}
+    maj = downsample(labels, [2, 2, 2], "majority")
+    assert set(np.unique(maj)) <= {0, 7}
+    # majority of a window with 3 zeros + 1 seven is 0
+    mixed = np.zeros((2, 2, 2), "uint64")
+    mixed[0, 0, 0] = 5
+    assert downsample(mixed, [2, 2, 2], "majority")[0, 0, 0] == 0
+
+    up = upsample(near, [2, 2, 2], "nearest")
+    assert up.shape == (4, 4, 4)
+    # anisotropic factor
+    aniso = downsample(x, [1, 2, 2], "mean")
+    assert aniso.shape == (4, 2, 2)
+
+
+def test_downscaling_workflow(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.downscaling import DownscalingWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 32, 32)
+    vol = np.random.RandomState(0).rand(*shape).astype("float32")
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("raw/s0", data=vol, chunks=[8, 16, 16])
+
+    wf = DownscalingWorkflow(
+        input_path=path, input_key="raw/s0",
+        scale_factors=[[1, 2, 2], [2, 2, 2]], output_key_prefix="raw",
+        metadata_dict={"resolution": [40.0, 4.0, 4.0]},
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        s1 = f["raw/s1"][:]
+        s2 = f["raw/s2"][:]
+        attrs1 = dict(s1f=f["raw/s1"].attrs.get("downsamplingFactors"),
+                      s2f=f["raw/s2"].attrs.get("downsamplingFactors"))
+        group_attrs = {k: f["raw"].attrs.get(k)
+                       for k in ("multiScale", "resolution")}
+    assert s1.shape == (16, 16, 16)
+    assert s2.shape == (8, 8, 8)
+    np.testing.assert_allclose(s1[0, 0, 0], vol[0, :2, :2].mean(), rtol=1e-5)
+    # paintera metadata in XYZ order
+    assert attrs1["s1f"] == [2, 2, 1]
+    assert attrs1["s2f"] == [4, 4, 2]
+    assert group_attrs["multiScale"] is True
+    assert group_attrs["resolution"] == [4.0, 4.0, 40.0]
+
+
+def test_copy_volume_requant(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.copy_volume import CopyVolumeTask
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 16, 16)
+    vol = np.random.RandomState(0).rand(*shape).astype("float32")
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("raw", data=vol, chunks=[8, 8, 8])
+
+    task = CopyVolumeTask(
+        input_path=path, input_key="raw", output_path=path,
+        output_key="raw_u8", dtype="uint8", chunks=[16, 16, 16],
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([task], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        out = f["raw_u8"]
+        assert out.dtype == np.uint8
+        assert tuple(out.chunks) == (16, 16, 16)
+        data = out[:]
+    np.testing.assert_allclose(data, np.round(vol * 255), atol=1)
+
+    # channel reduction of a 4d stack
+    affs = np.random.RandomState(1).rand(3, *shape).astype("float32")
+    with file_reader(path) as f:
+        f.create_dataset("affs", data=affs, chunks=[1, 8, 8, 8])
+    task = CopyVolumeTask(
+        input_path=path, input_key="affs", output_path=path,
+        output_key="bmap", reduce_channels="mean", identifier="reduce",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([task], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        bmap = f["bmap"][:]
+    np.testing.assert_allclose(bmap, affs.mean(0), rtol=1e-5)
+
+
+def test_paintera_conversion(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.paintera import (
+        PainteraConversionWorkflow, label_to_blocks)
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 16, 16)
+    seg = np.zeros(shape, "uint64")
+    seg[:, :8, :] = 1
+    seg[:, 8:, :] = 2
+    path = str(tmp_path / "d.n5")
+    out_path = str(tmp_path / "paintera.n5")
+    assignments = np.array([0, 10, 10], "uint64")  # both fragments -> seg 10
+    assign_path = str(tmp_path / "assign.npy")
+    np.save(assign_path, assignments)
+    with file_reader(path) as f:
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = 2
+
+    wf = PainteraConversionWorkflow(
+        input_path=path, input_key="seg", path=out_path,
+        label_group="labels", scale_factors=[[2, 2, 2]],
+        assignment_path=assign_path,
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(out_path, "r") as f:
+        s0 = f["labels/data/s0"][:]
+        s1 = f["labels/data/s1"][:]
+        attrs = {k: f["labels"].attrs.get(k)
+                 for k in ("painteraData", "maxId", "labelBlockLookup")}
+        data_attrs = f["labels/data"].attrs
+        assert data_attrs["multiScale"] is True
+        pairs = f["labels/fragment-segment-assignment"][:]
+    np.testing.assert_array_equal(s0, seg)
+    assert s1.shape == (8, 8, 8)
+    assert set(np.unique(s1)) <= {0, 1, 2}
+    assert attrs["painteraData"] == {"type": "label"}
+    assert attrs["maxId"] == 2
+    # fragment 1 and 2 both map to the same (offset) segment
+    assert pairs.shape[0] == 2
+    assert pairs[1, 0] == pairs[1, 1]
+
+    # label-to-block lookup: label 1 occupies the y<8 blocks of s0
+    blocks = label_to_blocks(out_path, "labels/label-to-block-mapping/s0", 1)
+    assert blocks is not None and len(blocks) >= 1
+
+
+def test_bigcat_export(tmp_workdir, tmp_path):
+    import h5py
+
+    from cluster_tools_tpu.workflows.paintera import BigcatWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (8, 8, 8)
+    seg = np.ones(shape, "uint64")
+    seg[:, 4:, :] = 2
+    path = str(tmp_path / "d.n5")
+    out_path = str(tmp_path / "bigcat.h5")
+    assign_path = str(tmp_path / "assign.npy")
+    np.save(assign_path, np.array([0, 5, 5], "uint64"))
+    with file_reader(path) as f:
+        f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+
+    wf = BigcatWorkflow(
+        input_path=path, input_key="seg", output_path=out_path,
+        assignment_path=assign_path, assignment_key=None,
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with h5py.File(out_path, "r") as f:
+        frags = f["volumes/labels/fragments"][:]
+        lut = f["fragment_segment_lut"][:]
+        assert "next_id" in f.attrs
+    np.testing.assert_array_equal(frags, seg)
+    assert lut.shape[0] == 2
