@@ -1,11 +1,13 @@
 """Benchmark: DT-watershed block pipeline throughput (voxels/sec).
 
 Config 1 of BASELINE.json ("Distance-transform watershed on a CREMI-like
-boundary map, single block").  The device path is the framework's jitted
-EDT -> seeds -> seeded-watershed pipeline (cluster_tools_tpu/ops); the
-baseline is the same pipeline computed with scipy.ndimage on the host CPU —
-the stand-in for the reference's vigra-based `target='local'` per-block
-compute (reference: watershed/watershed.py:285-341).
+boundary map, single block") at the reference's standard block size
+[50, 512, 512] (reference: cluster_tasks.py:217 default block_shape).  The
+device path is the framework's jitted EDT -> seeds -> seeded-watershed
+pipeline (cluster_tools_tpu/ops); the baseline is the same pipeline computed
+with scipy.ndimage on the host CPU — the stand-in for the reference's
+vigra-based `target='local'` per-block compute (reference:
+watershed/watershed.py:285-341).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -17,20 +19,20 @@ import time
 
 import numpy as np
 
-SHAPE = (32, 256, 256)  # one CREMI-like block (z-thin EM geometry)
+SHAPE = (50, 512, 512)  # the reference's standard block (cluster_tasks.py:217)
 
 
-def synthetic_boundary_map(shape, seed=0):
+def synthetic_boundary_map(shape, n_cells=160, seed=0):
     """Smooth cell-boundary-like map in [0, 1]: distance ridges of a random
     point set, the standard synthetic stand-in for an EM membrane map."""
     rng = np.random.RandomState(seed)
-    pts = rng.rand(40, 3) * np.array(shape)
-    zz, yy, xx = np.meshgrid(*[np.arange(s) for s in shape], indexing="ij")
-    coords = np.stack([zz, yy, xx], -1).astype(np.float32)
-    d = np.full(shape, np.inf, np.float32)
-    d2 = np.full(shape, np.inf, np.float32)
-    for p in pts.astype(np.float32):
-        dist = np.linalg.norm(coords - p, axis=-1)
+    pts = (rng.rand(n_cells, 3) * np.array(shape)).astype("float32")
+    zz, yy, xx = np.meshgrid(*[np.arange(s, dtype="float32") for s in shape],
+                             indexing="ij")
+    d = np.full(shape, np.inf, "float32")
+    d2 = np.full(shape, np.inf, "float32")
+    for p in pts:
+        dist = np.sqrt((zz - p[0]) ** 2 + (yy - p[1]) ** 2 + (xx - p[2]) ** 2)
         nearer = dist < d
         d2 = np.where(nearer, d, np.minimum(d2, dist))
         d = np.where(nearer, dist, d)
@@ -38,13 +40,16 @@ def synthetic_boundary_map(shape, seed=0):
     return ridge.astype(np.float32)
 
 
-def bench_device(data, cfg, repeats=3):
-    from cluster_tools_tpu.workflows.watershed import run_ws_block
+def bench_device(data, cfg, repeats=4):
+    """Streamed block throughput: the deployment pattern overlaps transfers
+    with compute (run_ws_blocks_stream), so the metric is stream rate, not
+    single-block latency."""
+    from cluster_tools_tpu.workflows.watershed import run_ws_blocks_stream
 
-    run_ws_block(data, cfg)  # warmup: compile
+    run_ws_blocks_stream([data], cfg)  # warmup: compile
+    blocks = [data] * repeats
     t0 = time.perf_counter()
-    for _ in range(repeats):
-        run_ws_block(data, cfg)
+    run_ws_blocks_stream(blocks, cfg)
     return (time.perf_counter() - t0) / repeats
 
 

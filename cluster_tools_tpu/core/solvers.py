@@ -25,7 +25,8 @@ def multicut_kernighan_lin(n_nodes: int, uv_ids: np.ndarray,
                            costs: np.ndarray,
                            time_limit: Optional[float] = None,
                            n_threads: int = 1) -> np.ndarray:
-    return native.multicut_kernighan_lin(n_nodes, uv_ids, costs)
+    return native.multicut_kernighan_lin(n_nodes, uv_ids, costs,
+                                         time_limit=time_limit or 0.0)
 
 
 def multicut_decomposition(n_nodes: int, uv_ids: np.ndarray,
@@ -59,7 +60,8 @@ def multicut_decomposition(n_nodes: int, uv_ids: np.ndarray,
         remap = {n: i for i, n in enumerate(nodes)}
         local_uv = np.array([[remap[u], remap[v]] for u, v in sub_uv],
                             dtype="int64")
-        sub = native.multicut_kernighan_lin(len(nodes), local_uv, sub_costs)
+        sub = native.multicut_kernighan_lin(len(nodes), local_uv, sub_costs,
+                                            time_limit=time_limit or 0.0)
         return nodes, sub
 
     results = []

@@ -165,12 +165,30 @@ class Dataset:
             for c, cs, s in zip(chunk_id, self.chunks, self.shape)
         )
 
+    def _chunk_file(self, chunk_id: Sequence[int]) -> str:
+        if self.flavor == "zarr":
+            sep = getattr(self, "_dim_sep", None)
+            if sep is None:
+                try:
+                    with open(os.path.join(self.path, ".zarray")) as f:
+                        sep = json.load(f).get("dimension_separator", ".")
+                except OSError:
+                    sep = "."
+                self._dim_sep = sep
+            name = sep.join(str(c) for c in chunk_id)
+            return os.path.join(self.path, *name.split("/"))
+        # N5 metadata (and chunk directories) are column-major on disk; the
+        # Dataset view transposes to C-order, so reverse the chunk id
+        return os.path.join(self.path,
+                            *[str(c) for c in reversed(tuple(chunk_id))])
+
     def read_chunk(self, chunk_id: Sequence[int]) -> Optional[np.ndarray]:
-        bb = self._chunk_bb(chunk_id)
-        data = self[bb]
-        if not data.any():
+        """None for chunks never written; a present all-zero chunk returns
+        zeros (z5 semantics distinguish missing from zero — an r1 advisor
+        finding: conflating them silently drops legitimate zero results)."""
+        if not os.path.exists(self._chunk_file(chunk_id)):
             return None
-        return data
+        return self[self._chunk_bb(chunk_id)]
 
     def write_chunk(self, chunk_id: Sequence[int], data: np.ndarray) -> None:
         bb = self._chunk_bb(chunk_id)
@@ -305,6 +323,7 @@ class ZarrFile(_TSContainer):
     def _dataset_spec(self, key: str) -> Dict[str, Any]:
         return {
             "driver": "zarr",
+            "store_data_equal_to_fill_value": True,
             "kvstore": {"driver": "file", "path": os.path.join(self.path, key)},
         }
 
@@ -316,6 +335,7 @@ class ZarrFile(_TSContainer):
             compressor = {"id": "blosc", "cname": "lz4", "clevel": 5, "shuffle": 1}
         return {
             "driver": "zarr",
+            "store_data_equal_to_fill_value": True,
             "kvstore": {"driver": "file", "path": os.path.join(self.path, key)},
             "metadata": {
                 "shape": list(shape),
@@ -346,6 +366,7 @@ class N5File(_TSContainer):
     def _dataset_spec(self, key: str) -> Dict[str, Any]:
         return {
             "driver": "n5",
+            "store_data_equal_to_fill_value": True,
             "kvstore": {"driver": "file", "path": os.path.join(self.path, key)},
         }
 
@@ -358,6 +379,7 @@ class N5File(_TSContainer):
             comp = {"type": "gzip", "level": 1}
         return {
             "driver": "n5",
+            "store_data_equal_to_fill_value": True,
             "kvstore": {"driver": "file", "path": os.path.join(self.path, key)},
             "metadata": {
                 # N5 metadata is column-major; tensorstore handles the

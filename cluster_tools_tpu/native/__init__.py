@@ -79,7 +79,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ufd_merge_pairs.argtypes = [i64, i64, p_i64, p_u64]
         lib.mc_gaec.argtypes = [i64, i64, p_i64, p_f64, p_u64]
         lib.mc_gaec.restype = i64
-        lib.mc_kl_refine.argtypes = [i64, i64, p_i64, p_f64, p_u64, i64]
+        lib.mc_kl_refine.argtypes = [i64, i64, p_i64, p_f64, p_u64, i64,
+                                     ctypes.c_double]
         lib.mc_kl_refine.restype = i64
         lib.mc_objective.argtypes = [i64, i64, p_i64, p_f64, p_u64]
         lib.mc_objective.restype = ctypes.c_double
@@ -91,7 +92,7 @@ def _load() -> Optional[ctypes.CDLL]:
                                  p_u64]
         lib.lmc_gaec.restype = i64
         lib.lmc_kl_refine.argtypes = [i64, i64, p_i64, p_f64, i64, p_i64,
-                                      p_f64, p_u64, i64]
+                                      p_f64, p_u64, i64, ctypes.c_double]
         lib.lmc_kl_refine.restype = i64
         lib.agglomerate_edge_weighted.argtypes = [
             i64, i64, p_i64, p_f64, p_f64, p_f64, ctypes.c_double,
@@ -155,9 +156,13 @@ def multicut_gaec(n_nodes: int, uv_ids: np.ndarray,
 
 def multicut_kernighan_lin(n_nodes: int, uv_ids: np.ndarray,
                            costs: np.ndarray, warmstart: bool = True,
-                           max_passes: int = 50) -> np.ndarray:
+                           max_passes: int = 50,
+                           time_limit: float = 0.0) -> np.ndarray:
     """GAEC warmstart + Kernighan-Lin-style greedy node moves (the nifty
-    multicutKernighanLin role: polish a partition with local search)."""
+    multicutKernighanLin role: polish a partition with local search).
+    ``time_limit`` (seconds, 0 = none) bounds the refinement passes — the
+    reference's time-limited solver visitor (segmentation_utils.py:166-181);
+    the warmstart always completes, so a valid partition is returned."""
     uv = _as_uv(uv_ids)
     costs = np.ascontiguousarray(costs, dtype=np.float64)
     labels = (multicut_gaec(n_nodes, uv, costs) if warmstart
@@ -165,9 +170,11 @@ def multicut_kernighan_lin(n_nodes: int, uv_ids: np.ndarray,
     lib = _load()
     if lib is not None:
         labels = np.ascontiguousarray(labels, dtype=np.uint64)
-        lib.mc_kl_refine(n_nodes, len(uv), uv, costs, labels, max_passes)
+        lib.mc_kl_refine(n_nodes, len(uv), uv, costs, labels, max_passes,
+                         float(time_limit or 0.0))
         return labels
-    return _py_moves(n_nodes, uv, costs, labels, max_passes)
+    return _py_moves(n_nodes, uv, costs, labels, max_passes,
+                     time_limit=time_limit)
 
 
 def multicut_objective(uv_ids: np.ndarray, costs: np.ndarray,
@@ -229,7 +236,11 @@ def _py_gaec(n_nodes: int, uv: np.ndarray, costs: np.ndarray) -> np.ndarray:
 
 
 def _py_moves(n_nodes: int, uv: np.ndarray, costs: np.ndarray,
-              labels: np.ndarray, max_passes: int) -> np.ndarray:
+              labels: np.ndarray, max_passes: int,
+              time_limit: float = 0.0) -> np.ndarray:
+    import time as _time
+
+    deadline = _time.monotonic() + time_limit if time_limit else None
     labels = labels.astype(np.uint64).copy()
     nbrs = [dict() for _ in range(n_nodes)]
     for (u, v), c in zip(uv, costs):
@@ -237,6 +248,8 @@ def _py_moves(n_nodes: int, uv: np.ndarray, costs: np.ndarray,
         nbrs[v][u] = nbrs[v].get(u, 0.0) + c
     next_label = int(labels.max()) + 1 if n_nodes else 0
     for _ in range(max_passes):
+        if deadline is not None and _time.monotonic() > deadline:
+            break
         improved = False
         for x in range(n_nodes):
             if not nbrs[x]:
@@ -287,7 +300,8 @@ def lifted_multicut_kernighan_lin(n_nodes: int, uv_ids: np.ndarray,
                                   lifted_uv_ids: np.ndarray,
                                   lifted_costs: np.ndarray,
                                   warmstart: bool = True,
-                                  max_passes: int = 50) -> np.ndarray:
+                                  max_passes: int = 50,
+                                  time_limit: float = 0.0) -> np.ndarray:
     """Lifted GAEC warmstart + KL-style node moves over the lifted objective
     (nifty liftedMulticutKernighanLin equivalent)."""
     uv = _as_uv(uv_ids)
@@ -300,9 +314,10 @@ def lifted_multicut_kernighan_lin(n_nodes: int, uv_ids: np.ndarray,
     if lib is not None:
         labels = np.ascontiguousarray(labels, dtype=np.uint64)
         lib.lmc_kl_refine(n_nodes, len(uv), uv, c, len(luv), luv, lc,
-                          labels, max_passes)
+                          labels, max_passes, float(time_limit or 0.0))
         return labels
-    return _py_lmc_moves(n_nodes, uv, c, luv, lc, labels, max_passes)
+    return _py_lmc_moves(n_nodes, uv, c, luv, lc, labels, max_passes,
+                         time_limit=time_limit)
 
 
 def lifted_objective(uv_ids: np.ndarray, costs: np.ndarray,
@@ -375,7 +390,11 @@ def _py_lmc_gaec(n_nodes, uv, c, luv, lc):
     return labels.astype(np.uint64)
 
 
-def _py_lmc_moves(n_nodes, uv, c, luv, lc, labels, max_passes):
+def _py_lmc_moves(n_nodes, uv, c, luv, lc, labels, max_passes,
+                  time_limit: float = 0.0):
+    import time as _time
+
+    deadline = _time.monotonic() + time_limit if time_limit else None
     labels = labels.astype(np.uint64).copy()
     local = [dict() for _ in range(n_nodes)]
     lifted = [dict() for _ in range(n_nodes)]
@@ -387,6 +406,8 @@ def _py_lmc_moves(n_nodes, uv, c, luv, lc, labels, max_passes):
         lifted[v][u] = lifted[v].get(u, 0.0) + w
     next_label = int(labels.max()) + 1 if n_nodes else 0
     for _ in range(max_passes):
+        if deadline is not None and _time.monotonic() > deadline:
+            break
         improved = False
         for x in range(n_nodes):
             if not local[x]:

@@ -11,6 +11,7 @@
 // Build: g++ -O3 -march=native -shared -fPIC solvers.cpp -o libctt_native.so
 
 #include <algorithm>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -158,7 +159,10 @@ int64_t mc_gaec(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
 // component (or a fresh singleton) with the best objective gain, until a full
 // pass yields no improvement or max_passes is hit.  Returns passes used.
 int64_t mc_kl_refine(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
-                     const double* costs, uint64_t* labels, int64_t max_passes) {
+                     const double* costs, uint64_t* labels, int64_t max_passes,
+                     double time_limit) {
+    const auto deadline = std::chrono::steady_clock::now() +
+        std::chrono::duration<double>(time_limit > 0 ? time_limit : 1e18);
     // CSR adjacency
     std::vector<int64_t> deg(n_nodes, 0);
     for (int64_t i = 0; i < n_edges; ++i) {
@@ -183,6 +187,7 @@ int64_t mc_kl_refine(int64_t n_nodes, int64_t n_edges, const int64_t* uv,
     std::unordered_map<uint64_t, double> comp_w;
     int64_t pass = 0;
     for (; pass < max_passes; ++pass) {
+        if (std::chrono::steady_clock::now() > deadline) break;
         bool improved = false;
         for (int64_t x = 0; x < n_nodes; ++x) {
             if (off[x + 1] == off[x]) continue;
@@ -396,7 +401,10 @@ int64_t lmc_gaec(int64_t n_nodes, int64_t n_local, const int64_t* uv_local,
 int64_t lmc_kl_refine(int64_t n_nodes, int64_t n_local, const int64_t* uv_local,
                       const double* costs_local, int64_t n_lifted,
                       const int64_t* uv_lifted, const double* costs_lifted,
-                      uint64_t* labels, int64_t max_passes) {
+                      uint64_t* labels, int64_t max_passes,
+                      double time_limit) {
+    const auto deadline = std::chrono::steady_clock::now() +
+        std::chrono::duration<double>(time_limit > 0 ? time_limit : 1e18);
     auto build_csr = [n_nodes](int64_t n_e, const int64_t* uv, const double* c,
                                std::vector<int64_t>& off,
                                std::vector<int64_t>& nbr,
@@ -432,6 +440,7 @@ int64_t lmc_kl_refine(int64_t n_nodes, int64_t n_local, const int64_t* uv_local,
     std::unordered_set<uint64_t> local_comps;
     int64_t pass = 0;
     for (; pass < max_passes; ++pass) {
+        if (std::chrono::steady_clock::now() > deadline) break;
         bool improved = false;
         for (int64_t x = 0; x < n_nodes; ++x) {
             if (loff[x + 1] == loff[x]) continue;

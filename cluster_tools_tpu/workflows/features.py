@@ -201,16 +201,22 @@ class MergeEdgeFeatures(BlockTask):
                        if n.startswith("block_") and n.endswith(".npz")]
         f_out = file_reader(cfg["output_path"])
         ds = f_out[cfg["output_key"]]
-        for e0 in job_config["block_list"]:
-            e1 = min(e0 + chunk, n_edges)
-            partials = []
-            for path in block_files:
-                with np.load(path) as d:
-                    ids, feats = d["edge_ids"], d["features"]
+        # one pass over the block files per JOB: each file is read once and
+        # its rows binned into every owned edge range (the r1-flagged
+        # O(blocks x ranges) re-read pattern scaled as blocks x jobs x
+        # ranges_per_job at terabyte volumes)
+        ranges = [(e0, min(e0 + chunk, n_edges))
+                  for e0 in job_config["block_list"]]
+        partials = {e0: [] for e0, _ in ranges}
+        for path in block_files:
+            with np.load(path) as d:
+                ids, feats = d["edge_ids"], d["features"]
+            for e0, e1 in ranges:
                 sel = (ids >= e0) & (ids < e1)
                 if sel.any():
-                    partials.append((ids[sel] - e0, feats[sel]))
-            merged = merge_feature_blocks(partials, e1 - e0)
+                    partials[e0].append((ids[sel] - e0, feats[sel]))
+        for e0, e1 in ranges:
+            merged = merge_feature_blocks(partials[e0], e1 - e0)
             ds[slice(e0, e1), slice(0, 10)] = merged
             log_fn(f"processed block {e0}")
 

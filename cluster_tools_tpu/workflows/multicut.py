@@ -131,7 +131,8 @@ class SolveSubproblems(BlockTask):
         sub_nodes, local_uv_flat = np.unique(sub_uv, return_inverse=True)
         local_uv = local_uv_flat.reshape(-1, 2).astype("int64")
         sub_costs = costs[inner]
-        sub_res = agglomerator(len(sub_nodes), local_uv, sub_costs)
+        sub_res = agglomerator(len(sub_nodes), local_uv, sub_costs,
+                               time_limit=cfg.get("time_limit_solver"))
         cut_mask = sub_res[local_uv[:, 0]] != sub_res[local_uv[:, 1]]
         return inner[cut_mask]
 
@@ -349,7 +350,8 @@ class SolveGlobal(BlockTask):
 
         uv_dense, n_nodes, s0_nodes = _load_scale_graph(problem_path, scale)
         costs = _load_costs(problem_path, scale)
-        labels = agglomerator(n_nodes, uv_dense.astype("int64"), costs)
+        labels = agglomerator(n_nodes, uv_dense.astype("int64"), costs,
+                              time_limit=cfg.get("time_limit_solver"))
         log_fn(f"global solve: {n_nodes} nodes -> "
                f"{len(np.unique(labels))} segments")
 

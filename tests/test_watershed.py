@@ -204,3 +204,18 @@ def test_watershed_2d_mode_slices_independent(tmp_workdir, tmp_path):
     for lab in np.unique(ws):
         zs = np.unique(np.nonzero(ws == lab)[0])
         assert len(zs) == 1, f"label {lab} spans slices {zs}"
+
+
+def test_streamed_pipeline_matches_blockwise():
+    """run_ws_blocks_stream (the fused bench/deployment path) produces the
+    same fragments as run_ws_block on the 3d no-mask path."""
+    from cluster_tools_tpu.workflows.watershed import (run_ws_block,
+                                                       run_ws_blocks_stream)
+
+    vol = _boundary_volume((16, 24, 24), n_cells=4)
+    cfg = {"threshold": 0.5, "sigma_seeds": 2.0, "sigma_weights": 2.0,
+           "alpha": 0.8, "size_filter": 0}
+    single = run_ws_block(vol, cfg)
+    streamed = run_ws_blocks_stream([vol, vol], cfg)
+    np.testing.assert_array_equal(streamed[0], single)
+    np.testing.assert_array_equal(streamed[1], single)
