@@ -15,15 +15,12 @@ from .core.workflow import Task, DummyTask, build
 from .core.runtime import BlockTask, FailedJobsError
 from .core.blocking import Blocking, blocks_in_volume, block_to_bb
 from .core.storage import file_reader
-# top-level workflow re-exports (reference: cluster_tools/__init__.py:1-9)
-from .workflows import (AgglomerativeClusteringWorkflow,
-                        LiftedMulticutSegmentationWorkflow,
-                        MulticutSegmentationWorkflow, MwsWorkflow,
-                        SimpleStitchingWorkflow)
+# workflow re-exports (reference: cluster_tools/__init__.py:1-9; the full
+# workflow surface is re-exported so users address everything from the root)
+from .workflows import *  # noqa: F401,F403
+from . import workflows as _workflows
 
 __all__ = [
     "Task", "DummyTask", "build", "BlockTask", "FailedJobsError",
     "Blocking", "blocks_in_volume", "block_to_bb", "file_reader",
-    "AgglomerativeClusteringWorkflow", "LiftedMulticutSegmentationWorkflow",
-    "MulticutSegmentationWorkflow", "MwsWorkflow", "SimpleStitchingWorkflow",
-]
+] + list(_workflows.__all__)

@@ -538,10 +538,12 @@ class VarlenDataset:
 HDF5_EXTS = {".h5", ".hdf", ".hdf5"}
 ZARR_EXTS = {".zarr", ".zr"}
 N5_EXTS = {".n5"}
+KNOSSOS_EXTS = {".knossos", ".k"}
 
 
 def file_reader(path: str, mode: str = "a"):
-    """Open a container by extension (reference: utils/volume_utils.py:33-43)."""
+    """Open a container by extension (reference: utils/volume_utils.py:33-43,
+    incl. the read-only Knossos pyramid dispatch)."""
     ext = os.path.splitext(path)[1].lower()
     if ext in N5_EXTS:
         return N5File(path, mode)
@@ -549,6 +551,10 @@ def file_reader(path: str, mode: str = "a"):
         return ZarrFile(path, mode)
     if ext in HDF5_EXTS:
         return H5File(path, mode)
+    if ext in KNOSSOS_EXTS:
+        from ..utils.knossos import KnossosFile
+
+        return KnossosFile(path, mode="r")
     raise ValueError(f"unsupported container extension: {path}")
 
 

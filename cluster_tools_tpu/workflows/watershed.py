@@ -145,16 +145,31 @@ def run_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
         float(cfg.get("sigma_seeds", 2.0)),
         float(cfg.get("sigma_weights", 2.0)),
         float(cfg.get("alpha", 0.8)))
-    outs = [pipeline(jnp.asarray(b)) for b in blocks]  # all queued async
     min_size = cfg.get("size_filter", 25)
+    # bounded look-ahead: dispatch a few blocks ahead, drain as results are
+    # consumed — unbounded queueing would hold every output buffer in HBM
+    # (~150 MB per reference-size block)
+    window = int(cfg.get("stream_window", 3))
+    from collections import deque
+
     results = []
-    for ws_dev, height_dev in outs:
+    pending: "deque" = deque()
+
+    def _drain():
+        ws_dev, height_dev = pending.popleft()
         ws = np.asarray(ws_dev)
         if min_size:
             # height is only transferred when the filter needs it for the
             # regrow (same flooding surface as run_ws_block)
             ws = size_filter(ws, np.asarray(height_dev), min_size)
         results.append(ws.astype("uint64"))
+
+    for b in blocks:
+        pending.append(pipeline(jnp.asarray(b)))  # queued async
+        if len(pending) > window:
+            _drain()
+    while pending:
+        _drain()
     return results
 
 

@@ -1,0 +1,43 @@
+"""Multiscale pyramid example (reference: example/downscale.py).
+
+    python example/downscale.py /tmp/ctt_downscale
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(workdir):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.core.storage import file_reader
+
+    os.makedirs(workdir, exist_ok=True)
+    data = os.path.join(workdir, "data.n5")
+    config_dir = os.path.join(workdir, "configs")
+    ConfigDir(config_dir).write_global_config({"block_shape": [16, 64, 64]})
+
+    raw = np.random.RandomState(0).rand(32, 256, 256).astype("float32")
+    with file_reader(data) as f:
+        f.create_dataset("raw/s0", data=raw, chunks=[16, 64, 64])
+
+    wf = ctt.DownscalingWorkflow(
+        input_path=data, input_key="raw/s0",
+        scale_factors=[[1, 2, 2], [2, 2, 2], [2, 2, 2]],
+        output_key_prefix="raw",
+        metadata_dict={"resolution": [40.0, 4.0, 4.0]},
+        tmp_folder=os.path.join(workdir, "tmp"), config_dir=config_dir,
+        max_jobs=4, target="local")
+    assert ctt.build([wf])
+
+    with file_reader(data, "r") as f:
+        for s in range(4):
+            print(f"raw/s{s}:", f[f"raw/s{s}"].shape)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/ctt_downscale")

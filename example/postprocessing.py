@@ -1,0 +1,50 @@
+"""Postprocessing example: size filter with watershed fill (reference:
+example/postprocessing.py).
+
+    python example/postprocessing.py /tmp/ctt_postprocess
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(workdir):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.core.storage import file_reader
+
+    os.makedirs(workdir, exist_ok=True)
+    data = os.path.join(workdir, "data.n5")
+    config_dir = os.path.join(workdir, "configs")
+    ConfigDir(config_dir).write_global_config({"block_shape": [16, 64, 64]})
+
+    # a segmentation with lots of tiny fragments
+    rng = np.random.RandomState(0)
+    seg = rng.randint(1, 2000, size=(32, 128, 128)).astype("uint64")
+    hmap = rng.rand(*seg.shape).astype("float32")
+    with file_reader(data) as f:
+        f.create_dataset("seg", data=seg, chunks=[16, 64, 64])
+        f.create_dataset("hmap", data=hmap, chunks=[16, 64, 64])
+
+    # random labels have ~260 voxels each; filter the smaller half and let
+    # the watershed fill regrow survivors into the freed space
+    wf = ctt.SizeFilterWorkflow(
+        input_path=data, input_key="seg",
+        output_path=data, output_key="filtered",
+        size_threshold=262, hmap_path=data, hmap_key="hmap",
+        tmp_folder=os.path.join(workdir, "tmp"), config_dir=config_dir,
+        max_jobs=4, target="local", relabel=True)
+    assert ctt.build([wf])
+
+    with file_reader(data, "r") as f:
+        out = f["filtered"][:]
+    print("segments before:", len(np.unique(seg)),
+          "after size filter:", len(np.unique(out)))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/ctt_postprocess")

@@ -1,0 +1,47 @@
+"""Skeletonization example (reference: example/skeletons.py).
+
+    python example/skeletons.py /tmp/ctt_skeletons
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(workdir):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.workflows.skeletons import (SkeletonWorkflow,
+                                                       load_skeleton)
+
+    os.makedirs(workdir, exist_ok=True)
+    data = os.path.join(workdir, "data.n5")
+    config_dir = os.path.join(workdir, "configs")
+    ConfigDir(config_dir).write_global_config({"block_shape": [16, 64, 64]})
+
+    # two tube-like objects
+    seg = np.zeros((16, 64, 64), "uint64")
+    seg[6:10, 6:10, 4:60] = 1
+    seg[6:10, 40:44, 4:60] = 2
+    with file_reader(data) as f:
+        ds = f.create_dataset("seg", data=seg, chunks=[16, 64, 64])
+        ds.attrs["maxId"] = 2
+
+    wf = SkeletonWorkflow(
+        input_path=data, input_key="seg", output_path=data,
+        output_key="skeletons", tmp_folder=os.path.join(workdir, "tmp"),
+        config_dir=config_dir, max_jobs=2, target="local")
+    assert ctt.build([wf])
+
+    for label in (1, 2):
+        coords = load_skeleton(data, "skeletons", label)
+        print(f"object {label}: {len(coords)} skeleton voxels, "
+              f"x-extent {coords[:, 2].min()}..{coords[:, 2].max()}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/ctt_skeletons")
