@@ -8,7 +8,10 @@ from .downscaling import DownscalingWorkflow
 from .graph import GraphWorkflow
 from .inference import InferenceTask
 from .masking import BlocksFromMask, MinFilterMask
+from .meshes import MeshWorkflow
 from .paintera import BigcatWorkflow, PainteraConversionWorkflow
+from .pixel_classification import (ImageFilterTask,
+                                   PixelClassificationWorkflow)
 from .multicut import MulticutWorkflow
 from .mutex_watershed import MwsWorkflow, TwoPassMwsWorkflow
 from .postprocess import (ConnectedComponentsWorkflow, FilterLabelsWorkflow,
@@ -35,7 +38,8 @@ from .watershed import (AgglomerateTask, WatershedFromSeedsTask,
 __all__ = [
     "BigcatWorkflow", "BlocksFromMask", "CheckComponents", "CheckSubGraphs",
     "CopyVolumeTask", "DecompositionWorkflow", "DownscalingWorkflow",
-    "InsertAffinities", "MinFilterMask", "PainteraConversionWorkflow",
+    "ImageFilterTask", "InsertAffinities", "MeshWorkflow", "MinFilterMask",
+    "PainteraConversionWorkflow", "PixelClassificationWorkflow",
     "SmoothedGradients",
     "AgglomerateTask", "AgglomerativeClusteringWorkflow",
     "ConnectedComponentsWorkflow", "FilterLabelsWorkflow",

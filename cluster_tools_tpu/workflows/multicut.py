@@ -361,6 +361,90 @@ class SolveGlobal(BlockTask):
         log_fn(f"assignments saved: {len(table)} fragment ids")
 
 
+class SubSolutions(BlockTask):
+    """Debug task: paint each block's local sub-solution into a volume so
+    per-block multicut results can be inspected before the reduce step
+    (reference: multicut/sub_solutions.py:31)."""
+
+    task_name = "sub_solutions"
+
+    def __init__(self, problem_path: str, scale: int, ws_path: str,
+                 ws_key: str, output_path: str, output_key: str, **kw):
+        self.problem_path = problem_path
+        self.scale = scale
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.identifier = f"s{scale}"
+        super().__init__(**kw)
+
+    def run_impl(self):
+        with file_reader(self.ws_path, "r") as f:
+            shape = list(f[self.ws_key].shape)
+        base_bs = self.global_block_shape()
+        scale_bs = [b * 2 ** self.scale for b in base_bs]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=shape,
+                              chunks=[min(c, s)
+                                      for c, s in zip(base_bs, shape)],
+                              dtype="uint64")
+        block_list = self.blocks_in_volume(shape, scale_bs)
+        self.run_jobs(block_list, {
+            "problem_path": self.problem_path, "scale": self.scale,
+            "ws_path": self.ws_path, "ws_key": self.ws_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "shape": shape, "block_shape": base_bs,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from .. import native
+
+        cfg = job_config["config"]
+        problem_path = cfg["problem_path"]
+        scale = int(cfg["scale"])
+        scale_bs = [b * 2 ** scale for b in cfg["block_shape"]]
+        blocking = Blocking(cfg["shape"], scale_bs)
+        uv_dense, n_nodes, s0_nodes = _load_scale_graph(problem_path, scale)
+        if scale > 0:
+            # ws carries original fragment labels: compose through the s0
+            # node table and the composed s0 -> scale node labeling
+            s0_nodes, _, _ = g.load_graph(problem_path, "s0/graph")
+            with file_reader(problem_path, "r") as f:
+                to_scale = f[f"s{scale}/node_labeling"][:].astype("int64")
+        else:
+            to_scale = None
+        f_ws = file_reader(cfg["ws_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_ws = f_ws[cfg["ws_key"]]
+        ds_out = f_out[cfg["output_key"]]
+
+        for block_id in job_config["block_list"]:
+            bb = blocking.get_block(block_id).bb
+            with np.load(_sub_result_path(problem_path, scale,
+                                          block_id)) as d:
+                cut_ids = d["cut_edge_ids"]
+            # block-local solution: merge every edge NOT cut by this block
+            merge = np.ones(len(uv_dense), bool)
+            merge[cut_ids] = False
+            roots = native.ufd_merge_pairs(n_nodes, uv_dense[merge])
+            ws = np.asarray(ds_ws[bb])
+            idx = np.searchsorted(s0_nodes, ws)
+            idx = np.minimum(idx, max(len(s0_nodes) - 1, 0))
+            valid = s0_nodes[idx] == ws
+            dense = idx if to_scale is None else to_scale[idx]
+            painted = np.where(valid, roots[dense] + 1, 0)
+            painted[ws == 0] = 0
+            # per-block offset keeps neighboring blocks' ids distinct
+            out = np.where(painted > 0,
+                           painted.astype("uint64")
+                           + np.uint64(block_id) * np.uint64(n_nodes + 1),
+                           np.uint64(0))
+            ds_out[bb] = out
+            log_fn(f"processed block {block_id}")
+
+
 class MulticutWorkflow(Task):
     """for scale in 0..n_scales-1: SolveSubproblems -> ReduceProblem; then
     SolveGlobal (reference: multicut_workflow.py:49-61)."""

@@ -1,0 +1,156 @@
+"""Pixel classification (ilastik replacement), image filters, meshes,
+sub_solutions debug task."""
+
+import numpy as np
+
+from cluster_tools_tpu.core.storage import file_reader
+from cluster_tools_tpu.core.workflow import build
+
+
+def test_image_filter_task(tmp_workdir, tmp_path):
+    from scipy import ndimage
+
+    from cluster_tools_tpu.workflows.pixel_classification import (
+        ImageFilterTask)
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 32, 32)
+    vol = np.random.RandomState(0).rand(*shape).astype("float32")
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("raw", data=vol, chunks=[8, 16, 16])
+
+    features = [["gaussianSmoothing", 1.5],
+                ["gaussianGradientMagnitude", 1.5]]
+    task = ImageFilterTask(
+        input_path=path, input_key="raw", output_path=path,
+        output_key="feats", features=features,
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([task], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        feats = f["feats"][:]
+    assert feats.shape == (2, *shape)
+    ref = ndimage.gaussian_filter(vol, 1.5, mode="reflect")
+    assert np.abs(feats[0] - ref).max() < 0.02
+    ref = ndimage.gaussian_gradient_magnitude(vol, 1.5, mode="reflect")
+    assert np.abs(feats[1] - ref).max() < 0.02
+
+
+def test_pixel_classification_workflow(tmp_workdir, tmp_path):
+    """Separable two-class problem: bright class 2, dark class 1."""
+    from cluster_tools_tpu.workflows.pixel_classification import (
+        PixelClassificationWorkflow)
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 32, 32)
+    rng = np.random.RandomState(0)
+    vol = rng.rand(*shape).astype("float32") * 0.2
+    vol[:, 16:, :] += 0.8  # bright half
+    scribbles = np.zeros(shape, "uint8")
+    # scribbles deep inside each half: large-sigma gradient features near
+    # the class boundary would otherwise leak boundary distance into the
+    # training signal
+    scribbles[4:8, 2:6, 8:24] = 1    # dark scribble
+    scribbles[4:8, 26:30, 8:24] = 2  # bright scribble
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("raw", data=vol, chunks=[8, 16, 16])
+        f.create_dataset("scribbles", data=scribbles, chunks=[8, 16, 16])
+
+    wf = PixelClassificationWorkflow(
+        input_path=path, input_key="raw", labels_path=path,
+        labels_key="scribbles", output_path=path, output_key="pred",
+        n_classes=2, tmp_folder=tmp_folder, config_dir=config_dir,
+        features=[["gaussianSmoothing", 0.7], ["gaussianSmoothing", 1.6],
+                  ["gaussianGradientMagnitude", 1.6]],
+        max_jobs=2, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        pred = f["pred"][:]
+    assert pred.shape == (2, *shape)
+    # away from the boundary, the classifier separates the halves
+    assert pred[1, :, 24:, :].mean() > 0.7   # bright half -> class 2
+    assert pred[0, :, :8, :].mean() > 0.7    # dark half -> class 1
+
+
+def test_mesh_workflow(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.meshes import MeshWorkflow, load_mesh
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 16, 16)
+    seg = np.zeros(shape, "uint64")
+    seg[4:12, 4:12, 4:12] = 1
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        ds = f.create_dataset("seg", data=seg, chunks=[8, 8, 8])
+        ds.attrs["maxId"] = 1
+
+    wf = MeshWorkflow(
+        input_path=path, input_key="seg", output_path=path,
+        output_key="meshes", tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    mesh = load_mesh(path, "meshes", 1)
+    assert mesh is not None
+    verts, faces = mesh
+    assert len(verts) > 50 and len(faces) > 50
+    # mesh vertices wrap the 8^3 cube (global coordinates)
+    assert verts.min() >= 2.5 and verts.max() <= 12.5
+
+
+def test_sub_solutions_debug_task(tmp_workdir, tmp_path):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.workflows.multicut import (SolveSubproblems,
+                                                      SubSolutions)
+    from cluster_tools_tpu.workflows.segmentation import ProblemWorkflow
+    from tests.test_multicut import _boundary_map, _nested_voronoi
+
+    tmp_folder, config_dir = tmp_workdir
+    true, frags = _nested_voronoi()
+    bnd = _boundary_map(true)
+    path = str(tmp_path / "d.n5")
+    problem = str(tmp_path / "p.n5")
+    with file_reader(path) as f:
+        f.create_dataset("bmap", data=bnd, chunks=(12, 12, 12))
+        f.create_dataset("ws", data=frags, chunks=(12, 12, 12))
+
+    common = dict(tmp_folder=tmp_folder, config_dir=config_dir,
+                  max_jobs=2, target="threads")
+    prob = ProblemWorkflow(
+        input_path=path, input_key="bmap", ws_path=path, ws_key="ws",
+        problem_path=problem, **common)
+    solve = SolveSubproblems(problem_path=problem, scale=0,
+                             dependency=prob, **common)
+    subs = SubSolutions(
+        problem_path=problem, scale=0, ws_path=path, ws_key="ws",
+        output_path=path, output_key="sub_solutions",
+        dependency=solve, **common)
+    assert ctt.build([subs], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        painted = f["sub_solutions"][:]
+    # every fragment got painted (no zeros: the ws has no background)
+    assert (painted > 0).all()
+    # sub-solutions merge fragments: fewer ids than fragments per block
+    assert len(np.unique(painted)) <= len(np.unique(frags))
+
+    # scale-1 path: composed through the s0 node table + node_labeling
+    from cluster_tools_tpu.workflows.multicut import ReduceProblem
+
+    reduce0 = ReduceProblem(problem_path=problem, scale=0,
+                            dependency=solve, **common)
+    solve1 = SolveSubproblems(problem_path=problem, scale=1,
+                              dependency=reduce0, **common)
+    subs1 = SubSolutions(
+        problem_path=problem, scale=1, ws_path=path, ws_key="ws",
+        output_path=path, output_key="sub_solutions_s1",
+        dependency=solve1, **common)
+    assert ctt.build([subs1], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        painted1 = f["sub_solutions_s1"][:]
+    assert (painted1 > 0).all()
+    assert len(np.unique(painted1)) <= len(np.unique(painted))
