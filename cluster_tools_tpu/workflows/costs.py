@@ -86,7 +86,8 @@ class ProbsToCosts(BlockTask):
 
     def __init__(self, input_path: str, input_key: str, output_path: str,
                  output_key: str, graph_path: str, graph_key: str = "graph",
-                 node_labels_path: str = "", node_labels_key: str = "", **kw):
+                 node_labels_path: str = "", node_labels_key: str = "",
+                 features_path: str = "", features_key: str = "", **kw):
         self.input_path = input_path
         self.input_key = input_key
         self.output_path = output_path
@@ -95,6 +96,10 @@ class ProbsToCosts(BlockTask):
         self.graph_key = graph_key
         self.node_labels_path = node_labels_path
         self.node_labels_key = node_labels_key
+        #: edge-feature table for size weighting when the input is a 1-D
+        #: RF probability vector
+        self.features_path = features_path
+        self.features_key = features_key
         super().__init__(**kw)
 
     @staticmethod
@@ -112,6 +117,8 @@ class ProbsToCosts(BlockTask):
             "graph_path": self.graph_path, "graph_key": self.graph_key,
             "node_labels_path": self.node_labels_path,
             "node_labels_key": self.node_labels_key,
+            "features_path": self.features_path or self.input_path,
+            "features_key": self.features_key or self.input_key,
         })
 
     @classmethod
@@ -119,10 +126,24 @@ class ProbsToCosts(BlockTask):
         cfg = job_config["config"]
         with file_reader(cfg["input_path"], "r") as f:
             feats = f[cfg["input_key"]][:]
-        probs = feats[:, 0]
+        # 2-D: the edge-feature table (col 0 = mean boundary prob, last =
+        # size); 1-D: an RF edge-probability vector (costs/predict.py path)
+        probs = feats[:, 0] if feats.ndim == 2 else feats
         if cfg.get("invert_inputs"):
             probs = 1.0 - probs
-        edge_sizes = feats[:, -1] if cfg.get("weight_edges") else None
+        edge_sizes = None
+        if cfg.get("weight_edges"):
+            if feats.ndim != 2:
+                with file_reader(cfg["features_path"], "r") as f:
+                    table = f[cfg["features_key"]]
+                    if len(table.shape) != 2:
+                        raise ValueError(
+                            "weight_edges needs the 2-D edge-feature table "
+                            "for sizes; pass features_path/features_key "
+                            "when the input is a 1-D probability vector")
+                    edge_sizes = table[:, table.shape[1] - 1]
+            else:
+                edge_sizes = feats[:, feats.shape[1] - 1]
         if cfg.get("transform_to_costs", True):
             costs = transform_probabilities_to_costs(
                 probs, beta=float(cfg.get("beta", 0.5)),
@@ -152,14 +173,16 @@ class ProbsToCosts(BlockTask):
 
 
 class EdgeCostsWorkflow(Task):
-    """[RF predict ->] ProbsToCosts (reference: costs_workflow.py)."""
+    """[RF predict ->] ProbsToCosts (reference: costs_workflow.py — the
+    optional sklearn RF edge classifier, costs/predict.py:104-147, replaces
+    the mean-boundary probability with learned edge probabilities)."""
 
     def __init__(self, features_path: str, features_key: str,
                  output_path: str, output_key: str, graph_path: str,
                  tmp_folder: str, config_dir: str, max_jobs: int = 1,
                  target: str = "local", node_labels_path: str = "",
                  node_labels_key: str = "", graph_key: str = "graph",
-                 dependency: Optional[Task] = None):
+                 rf_path: str = "", dependency: Optional[Task] = None):
         self.features_path = features_path
         self.features_key = features_key
         self.output_path = output_path
@@ -168,6 +191,7 @@ class EdgeCostsWorkflow(Task):
         self.graph_key = graph_key
         self.node_labels_path = node_labels_path
         self.node_labels_key = node_labels_key
+        self.rf_path = rf_path
         self.tmp_folder = tmp_folder
         self.config_dir = config_dir
         self.max_jobs = max_jobs
@@ -176,15 +200,28 @@ class EdgeCostsWorkflow(Task):
         super().__init__()
 
     def requires(self):
+        common = dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                      max_jobs=self.max_jobs, target=self.target)
+        dep = self.dependency
+        input_path, input_key = self.features_path, self.features_key
+        if self.rf_path:
+            from .learning import RFPredict
+
+            input_key = "rf_probs"
+            dep = RFPredict(
+                rf_path=self.rf_path, features_path=self.features_path,
+                features_key=self.features_key,
+                output_path=self.features_path, output_key=input_key,
+                dependency=dep, **common)
+            input_path = self.features_path
         return ProbsToCosts(
-            input_path=self.features_path, input_key=self.features_key,
+            input_path=input_path, input_key=input_key,
             output_path=self.output_path, output_key=self.output_key,
             graph_path=self.graph_path, graph_key=self.graph_key,
             node_labels_path=self.node_labels_path,
             node_labels_key=self.node_labels_key,
-            tmp_folder=self.tmp_folder, config_dir=self.config_dir,
-            max_jobs=self.max_jobs, target=self.target,
-            dependency=self.dependency)
+            features_path=self.features_path, features_key=self.features_key,
+            dependency=dep, **common)
 
     def output(self):
         from ..core.workflow import FileTarget

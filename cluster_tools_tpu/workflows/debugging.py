@@ -119,11 +119,11 @@ class CheckComponents(BlockTask):
         from scipy import ndimage
 
         cfg = job_config["config"]
+        from .morphology import decode_morphology
+
         with file_reader(cfg["morphology_path"], "r") as f:
             morpho = f[cfg["morphology_key"]][:]
-        sizes = morpho[:, 1]
-        bb_min = morpho[:, 5:8].astype("int64")
-        bb_max = morpho[:, 8:11].astype("int64") + 1
+        sizes, bb_min, bb_max = decode_morphology(morpho)
         f = file_reader(cfg["seg_path"], "r")
         ds = f[cfg["seg_key"]]
         struct = np.ones((3, 3, 3), bool)

@@ -16,7 +16,7 @@ import numpy as np
 from ..core.runtime import BlockTask
 from ..core.storage import file_reader
 from ..core.workflow import FileTarget, Task
-from .morphology import MorphologyWorkflow
+from .morphology import MorphologyWorkflow, decode_morphology
 
 
 class ComputeMeshes(BlockTask):
@@ -75,9 +75,7 @@ class ComputeMeshes(BlockTask):
         for block_id in job_config["block_list"]:
             lo, hi = block_id * chunk, min((block_id + 1) * chunk, n_labels)
             morpho = ds_morph[lo:hi, :]
-            sizes = morpho[:, 1]
-            bb_min = morpho[:, 5:8].astype("int64")
-            bb_max = morpho[:, 8:11].astype("int64") + 1
+            sizes, bb_min, bb_max = decode_morphology(morpho)
             for label_id in range(max(lo, 1), hi):
                 k = label_id - lo
                 if sizes[k] == 0 or (size_threshold

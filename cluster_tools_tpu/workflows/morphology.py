@@ -50,6 +50,13 @@ def block_morphology(seg: np.ndarray, offset) -> np.ndarray:
     return out
 
 
+def decode_morphology(table: np.ndarray):
+    """(sizes, bb_min, bb_max_exclusive) from morphology-table rows (the
+    column layout documented in the module docstring)."""
+    return (table[:, 1], table[:, 5:8].astype("int64"),
+            table[:, 8:11].astype("int64") + 1)
+
+
 def merge_morphology_rows(rows: np.ndarray) -> np.ndarray:
     """Merge per-block rows sharing label ids (count-weighted com, min/max
     bbox, summed sizes)."""
@@ -246,9 +253,7 @@ class RegionCenters(BlockTask):
             # chunk-aligned read of only the owned id range (the table can
             # be GBs at cluster scale; never load it whole per job)
             morpho = ds_morph[lo:hi, :]
-            sizes = morpho[:, 1]
-            bb_min = morpho[:, 5:8].astype("int64")
-            bb_max = morpho[:, 8:11].astype("int64") + 1
+            sizes, bb_min, bb_max = decode_morphology(morpho)
             centers = np.zeros((hi - lo, 3), "float32")
             for label_id in range(lo, hi):
                 if label_id == ignore or sizes[label_id - lo] == 0:

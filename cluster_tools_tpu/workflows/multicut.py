@@ -409,9 +409,11 @@ class SubSolutions(BlockTask):
         uv_dense, n_nodes, s0_nodes = _load_scale_graph(problem_path, scale)
         if scale > 0:
             # ws carries original fragment labels: compose through the s0
-            # node table and the composed s0 -> scale node labeling
-            s0_nodes, _, _ = g.load_graph(problem_path, "s0/graph")
+            # node table and the composed s0 -> scale node labeling (read
+            # just the node table — the s0 edge array is the largest object
+            # in the container and is not needed here)
             with file_reader(problem_path, "r") as f:
+                s0_nodes = f["s0/graph"]["nodes"][:]
                 to_scale = f[f"s{scale}/node_labeling"][:].astype("int64")
         else:
             to_scale = None

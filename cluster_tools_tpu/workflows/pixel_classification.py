@@ -146,12 +146,23 @@ class TrainPixelClassifier(BlockTask):
         from sklearn.ensemble import RandomForestClassifier
 
         cfg = job_config["config"]
-        with file_reader(cfg["input_path"], "r") as f:
-            ds = f[cfg["input_key"]]
-            data = ds[tuple(slice(0, s) for s in ds.shape)]
         with file_reader(cfg["labels_path"], "r") as f:
             ds = f[cfg["labels_key"]]
             labels = ds[tuple(slice(0, s) for s in ds.shape)]
+        # restrict feature computation to the scribble bounding box + filter
+        # halo: scribbles cover a tiny fraction of cluster-scale volumes,
+        # and the full-volume feature stack would not fit one host
+        nz = np.nonzero(labels > 0)
+        if len(nz[0]) == 0:
+            raise ValueError("no scribble labels > 0 found")
+        halo = _filter_halo(cfg["features"])
+        lo = [max(int(c.min()) - halo, 0) for c in nz]
+        hi = [min(int(c.max()) + 1 + halo, s)
+              for c, s in zip(nz, labels.shape)]
+        bb = tuple(slice(a, b) for a, b in zip(lo, hi))
+        with file_reader(cfg["input_path"], "r") as f:
+            data = np.asarray(f[cfg["input_key"]][bb])
+        labels = labels[bb]
         stack = compute_feature_stack(data, cfg["features"])
         sel = labels > 0
         X = stack[:, sel].T
@@ -222,6 +233,14 @@ class PredictPixelClassifier(BlockTask):
         ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
         dtype = np.dtype(cfg.get("dtype", "float32"))
         classes = list(rf.classes_)
+        bad = [int(c) for c in classes
+               if not 1 <= int(c) <= cfg["n_classes"]]
+        if bad:
+            raise ValueError(
+                f"classifier was trained on classes {classes} but the "
+                f"workflow allocates n_classes={cfg['n_classes']} channels "
+                f"(classes {bad} would be dropped) — scribble labels must "
+                "be 1..n_classes")
 
         for block_id in job_config["block_list"]:
             bh = blocking.get_block_with_halo(block_id, halo)

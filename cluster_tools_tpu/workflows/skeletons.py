@@ -82,9 +82,9 @@ class Skeletonize(BlockTask):
             lo, hi = block_id * chunk, min((block_id + 1) * chunk, n_labels)
             # chunk-aligned read of only the owned id range
             morpho = ds_morph[lo:hi, :]
-            sizes = morpho[:, 1]
-            bb_min = morpho[:, 5:8].astype("int64")
-            bb_max = morpho[:, 8:11].astype("int64") + 1
+            from .morphology import decode_morphology
+
+            sizes, bb_min, bb_max = decode_morphology(morpho)
             for label_id in range(max(lo, 1), hi):  # 0 = ignore label
                 if sizes[label_id - lo] == 0 or (
                         size_threshold

@@ -250,3 +250,54 @@ def test_filter_by_threshold_workflow(tmp_workdir, tmp_path):
     assert (out[seg == 2] == 0).all()
     assert (out[seg == 1] == 1).all()
     assert (out[seg == 3] == 3).all()
+
+
+def test_edge_costs_with_rf(tmp_workdir, tmp_path):
+    """EdgeCostsWorkflow(rf_path=...) chains RF prediction before the cost
+    transform (reference: costs_workflow.py RF branch)."""
+    from cluster_tools_tpu.core.graph import save_graph
+    from cluster_tools_tpu.workflows.costs import EdgeCostsWorkflow
+    from cluster_tools_tpu.workflows.learning import EdgeLabels, LearnRF
+
+    tmp_folder, config_dir = tmp_workdir
+    problem = str(tmp_path / "p.n5")
+    rng = np.random.RandomState(0)
+    n_edges = 200
+    labels = (rng.rand(n_edges) > 0.5).astype("int8")
+    feats = np.zeros((n_edges, 10), "float32")
+    feats[:, 0] = labels + 0.1 * rng.randn(n_edges)
+    uv = np.stack([np.arange(n_edges), np.arange(1, n_edges + 1)], 1)
+    node_labels = np.zeros(n_edges + 1, "uint64")
+    for i in range(n_edges):
+        node_labels[i + 1] = node_labels[i] + labels[i]
+    node_labels += 1
+
+    save_graph(problem, "s0/graph",
+               np.arange(n_edges + 1, dtype="uint64"), uv.astype("uint64"),
+               (1, 1, 1))
+    with file_reader(problem) as f:
+        f.create_dataset("features", data=feats)
+        f.create_dataset("gt_labels", data=node_labels)
+
+    common = dict(tmp_folder=tmp_folder, config_dir=config_dir,
+                  max_jobs=2, target="threads")
+    el = EdgeLabels(
+        graph_path=problem, graph_key="s0/graph",
+        node_labels_path=problem, node_labels_key="gt_labels",
+        output_path=problem, output_key="edge_labels", **common)
+    rf_path = str(tmp_path / "rf.pkl")
+    rf = LearnRF(features_dict={"a": (problem, "features")},
+                 labels_dict={"a": (problem, "edge_labels")},
+                 output_path=rf_path, dependency=el, **common)
+    costs_wf = EdgeCostsWorkflow(
+        features_path=problem, features_key="features",
+        output_path=problem, output_key="s0/costs",
+        graph_path=problem, graph_key="s0/graph",
+        rf_path=rf_path, dependency=rf, **common)
+    assert build([costs_wf], raise_on_failure=True)
+
+    with file_reader(problem, "r") as f:
+        costs = f["s0/costs"][:]
+    # cut edges (label 1, high RF prob) must be repulsive, merge attractive
+    assert (costs[labels == 1] < 0).mean() > 0.9
+    assert (costs[labels == 0] > 0).mean() > 0.9
