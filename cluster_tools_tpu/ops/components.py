@@ -57,9 +57,10 @@ def _shifted(arr: jnp.ndarray, offset: Sequence[int], fill) -> jnp.ndarray:
     return padded[tuple(slices)]
 
 
-@partial(jax.jit, static_argnames=("connectivity", "max_iter"))
+@partial(jax.jit, static_argnames=("connectivity", "max_iter", "method"))
 def connected_components(
-    mask: jnp.ndarray, connectivity: int = 1, max_iter: int = 0
+    mask: jnp.ndarray, connectivity: int = 1, max_iter: int = 0,
+    method: str = "hooking",
 ) -> jnp.ndarray:
     """Label connected components of a boolean mask.
 
@@ -69,14 +70,32 @@ def connected_components(
     volume size (2 * sum(shape) covers the worst-case path with pointer
     jumping's logarithmic compression well before the bound is hit; the loop
     exits early on convergence).
+
+    ``method``: both converge to the identical min-linear-index labeling.
+    'hooking' (Shiloach-Vishkin hook + pointer jumping) is O(log d)
+    iterations but each costs random gathers/scatters — the right choice for
+    large-diameter components.  'propagation' (pure neighbor-min stencil,
+    one voxel per iteration) has O(d) iterations of cheap fused VPU work
+    with NO gathers — far faster when component diameters are small (e.g.
+    watershed seed clusters), where the gather-heavy rounds dominate.
     """
+    if method not in ("hooking", "propagation"):
+        raise ValueError(f"unknown method {method!r}; "
+                         "choose 'hooking' or 'propagation'")
     shape = mask.shape
     n = int(np.prod(shape))
     sentinel = jnp.int32(n)
     mask = mask.astype(bool)
     offsets = _neighbor_offsets(len(shape), connectivity)
     if max_iter == 0:
-        max_iter = max(2 * int(np.sum(shape)), 16)
+        if method == "propagation":
+            # labels advance 4 voxels per iteration; the only safe
+            # data-independent bound on a component diameter is the voxel
+            # count (serpentine ridges realize it) — early exit on
+            # convergence makes the generous bound free in practice
+            max_iter = max(n // 4 + 2, 16)
+        else:
+            max_iter = max(2 * int(np.sum(shape)), 16)
 
     idx = jnp.arange(n, dtype=jnp.int32)
     fg = mask.reshape(-1)
@@ -88,6 +107,22 @@ def connected_components(
         for off in offsets:
             m = jnp.minimum(m, _shifted(grid, off, sentinel))
         return jnp.where(fg, m.reshape(-1), p)
+
+    if method == "propagation":
+        def body(state):
+            p, _, it = state
+            # 4 stencil sweeps per convergence check: amortizes the
+            # reduction, keeps everything fused elementwise VPU work
+            # (neighbor_min includes the center, so it is monotone)
+            p2 = p
+            for _ in range(4):
+                p2 = neighbor_min(p2)
+            return p2, jnp.any(p2 != p), it + 1
+
+        p, _, _ = jax.lax.while_loop(
+            lambda s: s[1] & (s[2] < max_iter), body,
+            (p0, jnp.bool_(True), jnp.int32(0)))
+        return jnp.where(fg, p + 1, 0).reshape(shape).astype(jnp.int32)
 
     def body(state):
         p, _ = state

@@ -98,7 +98,9 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
         dt_smooth = (jax.vmap(lambda d: gaussian(d, sigma_seeds))(dt)
                      if sigma_seeds else dt)
         maxima = jax.vmap(lambda d, f: local_maxima(d, 2) & f)(dt_smooth, fg)
-        seeds = jax.vmap(lambda m: connected_components(m, connectivity=2))(maxima)
+        # seed clusters are tiny: stencil propagation beats pointer jumping
+        seeds = jax.vmap(lambda m: connected_components(
+            m, connectivity=2, method="propagation"))(maxima)
         ws = seeded_watershed_batched(height, seeds, jmask, connectivity=1)
         # per-slice offsets in host uint64: device int32 would overflow for
         # n_slices * slice_size >= 2**31 (large in-plane blocks)
@@ -108,10 +110,12 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
                    * slice_size)[:, None, None]
         ws = np.where(ws > 0, ws + offsets, 0)
     else:
-        # seeds: connected maxima clusters of the smoothed DT
+        # seeds: connected maxima clusters of the smoothed DT (tiny
+        # clusters: stencil propagation beats gather-heavy pointer jumping)
         dt_smooth = gaussian(dt, sigma_seeds) if sigma_seeds else dt
         maxima = local_maxima(dt_smooth, radius=2) & fg
-        seeds = connected_components(maxima, connectivity=len(data.shape))
+        seeds = connected_components(maxima, connectivity=len(data.shape),
+                                     method="propagation")
         ws = np.array(seeded_watershed(height, seeds, jmask, connectivity=1))
     if min_size:
         ws = size_filter(ws, np.asarray(height), min_size,
@@ -196,7 +200,8 @@ def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
             1.0 - dt / jnp.maximum(dt.max(), 1e-6))
         dt_smooth = gaussian(dt, sigma_seeds) if sigma_seeds else dt
         maxima = local_maxima(dt_smooth, radius=2) & fg
-        seeds = connected_components(maxima, connectivity=3)
+        seeds = connected_components(maxima, connectivity=3,
+                                     method="propagation")
         return seeded_watershed(height, seeds, None, connectivity=1), height
 
     return pipeline
@@ -247,7 +252,8 @@ def run_ws_block_seeded(data: np.ndarray, cfg: Dict[str, Any],
     seeded_area = jnp.asarray(initial_seeds > 0)
     dt_smooth = gaussian(dt, sigma_seeds) if sigma_seeds else dt
     maxima = local_maxima(dt_smooth, radius=2) & fg & ~seeded_area
-    new_cc = connected_components(maxima, connectivity=data.ndim)
+    new_cc = connected_components(maxima, connectivity=data.ndim,
+                                  method="propagation")
     combined = jnp.where(jnp.asarray(dense_init) > 0, jnp.asarray(dense_init),
                          jnp.where(new_cc > 0, new_cc + k, 0))
     ws = np.asarray(seeded_watershed(height, combined, jmask, connectivity=1))
