@@ -94,13 +94,26 @@ def seeded_watershed(
     labels = seed_flat[parent]
     labels = jnp.where(mask.reshape(-1), labels, 0)
 
-    # fill voxels that drained into a non-seed minimum: repeatedly adopt the
-    # label of the lowest labeled neighbor (monotone flooding approximation)
+    # fill voxels the descent stage left unlabeled (plateaus, spurious
+    # non-seed minima) with a QUANTIZED PRIORITY FLOOD — the vigra
+    # watershedsNew ordering: heights are binned into L levels processed in
+    # ascending order; at each level, only voxels at-or-below the water
+    # level may adopt (from their lowest labeled neighbor), iterated to
+    # stability before the level rises.  A label can therefore only cross a
+    # saddle once the flood REACHES the saddle's level, by which time every
+    # basin below it has been claimed by its own seed — the unordered
+    # step-count race freely leaked labels across ridges into late-claimed
+    # pockets (fragment purity ~0.7 on CREMI-like geometry).
+    n_levels = 256
     hg = jnp.where(mask, height, big)
+    finite = jnp.where(mask, height, -big)
+    h_lo = jnp.where(mask, height, big).min()
+    h_hi = finite.max()
+    hq = jnp.clip(((hg - h_lo) / jnp.maximum(h_hi - h_lo, 1e-6)
+                   * (n_levels - 1)).astype(jnp.int32), 0, n_levels - 1)
+    hq = jnp.where(mask, hq, n_levels)
 
-    def fill_body(state):
-        lab, _, it = state
-        lab_g = lab.reshape(shape)
+    def lowest_labeled_neighbor(lab_g):
         nbr_h = jnp.full(shape, big)
         nbr_l = jnp.zeros(shape, jnp.int32)
         for off in offsets:
@@ -109,6 +122,37 @@ def seeded_watershed(
             cand = (ol > 0) & (oh < nbr_h)
             nbr_h = jnp.where(cand, oh, nbr_h)
             nbr_l = jnp.where(cand, ol, nbr_l)
+        return nbr_l
+
+    def flood_body(state):
+        lab, level, it = state
+        lab_g = lab.reshape(shape)
+        nbr_l = lowest_labeled_neighbor(lab_g)
+        adopt = (lab_g == 0) & mask & (nbr_l > 0) & (hq <= level)
+        new = jnp.where(adopt, nbr_l, lab_g).reshape(-1)
+        changed = jnp.any(new != lab)
+        # stable at this water level -> jump straight to the lowest level
+        # present on the frontier (skipping empty levels costs nothing and
+        # saves hundreds of no-op sweeps)
+        frontier = (lab_g == 0) & mask & (nbr_l > 0)
+        next_level = jnp.min(jnp.where(frontier, hq, n_levels))
+        level = jnp.where(changed, level,
+                          jnp.maximum(level + 1, next_level))
+        return new, level, it + 1
+
+    def flood_cond(state):
+        lab, level, it = state
+        return (level < n_levels) & (it < max_iter + n_levels)
+
+    labels, _, _ = jax.lax.while_loop(
+        flood_cond, flood_body, (labels, jnp.int32(0), jnp.int32(0)))
+
+    # leftovers unreachable by the flood (isolated pockets fully enclosed by
+    # the mask border): unordered sweep, arbitrary-side like any tie
+    def fill_body(state):
+        lab, _, it = state
+        lab_g = lab.reshape(shape)
+        nbr_l = lowest_labeled_neighbor(lab_g)
         adopt = (lab_g == 0) & mask & (nbr_l > 0)
         new = jnp.where(adopt, nbr_l, lab_g).reshape(-1)
         return new, jnp.any(new != lab), it + 1

@@ -219,3 +219,45 @@ def test_streamed_pipeline_matches_blockwise():
     streamed = run_ws_blocks_stream([vol, vol], cfg)
     np.testing.assert_array_equal(streamed[0], single)
     np.testing.assert_array_equal(streamed[1], single)
+
+
+def test_watershed_fragment_purity():
+    """Regression: the priority-flood fill must not leak labels across
+    ridges (the unordered fill silently merged basins: interior purity
+    ~0.7 on this geometry)."""
+    shape = (32, 64, 64)
+    rng = np.random.RandomState(0)
+    pts = (rng.rand(8, 3) * np.array(shape)).astype("float32")
+    grids = np.meshgrid(*[np.arange(s, dtype="float32") for s in shape],
+                        indexing="ij")
+    d1 = np.full(shape, np.inf, "float32")
+    d2 = np.full(shape, np.inf, "float32")
+    lab = np.zeros(shape, "uint64")
+    for i, p in enumerate(pts):
+        dist = np.sqrt(sum((g - c) ** 2 for g, c in zip(grids, p)))
+        nearer = dist < d1
+        d2 = np.where(nearer, d1, np.minimum(d2, dist))
+        lab = np.where(nearer, i + 1, lab)
+        d1 = np.where(nearer, dist, d1)
+    bnd = np.exp(-0.5 * ((d2 - d1) / 2.0) ** 2).astype("float32")
+
+    from cluster_tools_tpu.ops.overlaps import count_overlaps
+    from cluster_tools_tpu.workflows.watershed import run_ws_block
+
+    cfg = {"threshold": 0.4, "sigma_seeds": 2.0, "sigma_weights": 2.0,
+           "alpha": 0.8, "size_filter": 50}
+    ws = run_ws_block(bnd, cfg)
+    assert (ws > 0).all()
+
+    interior = (d2 - d1) > 4.0
+    iw, ig, counts = count_overlaps(np.where(interior, ws, 0),
+                                    np.where(interior, lab, 0))
+    keep = iw != 0
+    iw, counts = iw[keep], counts[keep]
+    tot = {}
+    best = {}
+    for w, c in zip(iw, counts):
+        tot[w] = tot.get(w, 0) + int(c)
+        best[w] = max(best.get(w, 0), int(c))
+    purity = np.array([best[w] / tot[w] for w in tot])
+    assert purity.min() > 0.97, purity
