@@ -1,89 +1,152 @@
-"""Benchmark: DT-watershed block pipeline throughput (voxels/sec).
+"""Benchmark: full multicut segmentation workflow throughput (voxels/sec).
 
-Config 1 of BASELINE.json ("Distance-transform watershed on a CREMI-like
-boundary map, single block") at the reference's standard block size
-[50, 512, 512] (reference: cluster_tasks.py:217 default block_shape).  The
-device path is the framework's jitted EDT -> seeds -> seeded-watershed
-pipeline (cluster_tools_tpu/ops); the baseline is the same pipeline computed
-with scipy.ndimage on the host CPU — the stand-in for the reference's
-vigra-based `target='local'` per-block compute (reference:
-watershed/watershed.py:285-341).
+Config 4 of BASELINE.json ("MulticutSegmentationWorkflow: RAG + edge
+features + hierarchical multicut") on a CREMI-like synthetic volume.  The
+device path runs the complete framework chain (blockwise DT watershed ->
+RAG -> edge features -> costs -> multicut -> write) under ``target='tpu'``
+twice and reports the steady-state second run (in-process jit caches warm —
+the deployment regime; the first run pays one-time XLA compiles).  The
+baseline is the SAME chain on the host CPU (subprocess, warm second run):
+identical code and identical parity, different backend — the measured
+stand-in for the reference's CPU ``target='local'`` path (vigra/nifty are
+not installable here; a scipy re-implementation failed to even reach
+segmentation parity, making its timing meaningless).
+
+Both paths must reach segmentation parity on the instance (adapted Rand
+error < 0.1 against the generating ground truth) for the number to count.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
 import os
+import shutil
 import sys
 import time
 
 import numpy as np
 
-SHAPE = (50, 512, 512)  # the reference's standard block (cluster_tasks.py:217)
+SHAPE = (64, 256, 256)
+BLOCK = [32, 128, 128]
+N_CELLS = 60
 
 
-def synthetic_boundary_map(shape, n_cells=160, seed=0):
-    """Smooth cell-boundary-like map in [0, 1]: distance ridges of a random
-    point set, the standard synthetic stand-in for an EM membrane map."""
+def synthetic_instance(shape=SHAPE, n_cells=N_CELLS, seed=0):
+    """(ground_truth, boundary_map): voronoi cells with smooth ridges."""
     rng = np.random.RandomState(seed)
     pts = (rng.rand(n_cells, 3) * np.array(shape)).astype("float32")
     zz, yy, xx = np.meshgrid(*[np.arange(s, dtype="float32") for s in shape],
                              indexing="ij")
-    d = np.full(shape, np.inf, "float32")
+    d1 = np.full(shape, np.inf, "float32")
     d2 = np.full(shape, np.inf, "float32")
-    for p in pts:
-        dist = np.sqrt((zz - p[0]) ** 2 + (yy - p[1]) ** 2 + (xx - p[2]) ** 2)
-        nearer = dist < d
-        d2 = np.where(nearer, d, np.minimum(d2, dist))
-        d = np.where(nearer, dist, d)
-    ridge = np.exp(-0.5 * ((d2 - d) / 2.0) ** 2)  # ~1 on ridges, ~0 inside
-    return ridge.astype(np.float32)
+    lab = np.zeros(shape, "uint64")
+    for i, p in enumerate(pts):
+        dist = np.sqrt((zz - p[0]) ** 2 + (yy - p[1]) ** 2
+                       + (xx - p[2]) ** 2)
+        nearer = dist < d1
+        d2 = np.where(nearer, d1, np.minimum(d2, dist))
+        lab = np.where(nearer, i + 1, lab)
+        d1 = np.where(nearer, dist, d1)
+    bnd = np.exp(-0.5 * ((d2 - d1) / 2.0) ** 2).astype("float32")
+    return lab, bnd
 
 
-def bench_device(data, cfg, repeats=4):
-    """Streamed block throughput: the deployment pattern overlaps transfers
-    with compute (run_ws_blocks_stream), so the metric is stream rate, not
-    single-block latency."""
-    from cluster_tools_tpu.workflows.watershed import run_ws_blocks_stream
+def run_device_chain(bnd, workdir):
+    """One full MulticutSegmentationWorkflow run; returns (seconds, seg)."""
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
 
-    run_ws_blocks_stream([data], cfg)  # warmup: compile
-    blocks = [data] * repeats
+    shutil.rmtree(workdir, ignore_errors=True)
+    config_dir = os.path.join(workdir, "configs")
+    cfg = ConfigDir(config_dir)
+    cfg.write_global_config({"block_shape": BLOCK})
+    cfg.write_task_config("watershed", {"threshold": 0.4, "size_filter": 50})
+    path = os.path.join(workdir, "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("bmap", data=bnd, chunks=BLOCK)
+
     t0 = time.perf_counter()
-    run_ws_blocks_stream(blocks, cfg)
-    return (time.perf_counter() - t0) / repeats
+    ws = WatershedWorkflow(
+        input_path=path, input_key="bmap", output_path=path,
+        output_key="ws", tmp_folder=os.path.join(workdir, "tmp"),
+        config_dir=config_dir, max_jobs=4, target="tpu")
+    mc = ctt.MulticutSegmentationWorkflow(
+        input_path=path, input_key="bmap", ws_path=path, ws_key="ws",
+        problem_path=os.path.join(workdir, "p.n5"), output_path=path,
+        output_key="seg", tmp_folder=os.path.join(workdir, "tmp"),
+        config_dir=config_dir, max_jobs=4, target="tpu", n_scales=1,
+        dependency=ws)
+    assert ctt.build([mc], raise_on_failure=True)
+    elapsed = time.perf_counter() - t0
+    with file_reader(path, "r") as f:
+        seg = f["seg"][:]
+    return elapsed, seg
 
 
-def bench_scipy(data, cfg):
-    from scipy import ndimage as ndi
+def run_cpu_chain(bnd, workdir):
+    """The SAME framework chain on the host CPU (subprocess with
+    JAX_PLATFORMS=cpu; warm second run, like the device side) — the
+    measured stand-in for the reference's CPU `target='local'` path, and
+    the honest hardware comparison: identical code, identical parity,
+    different backend."""
+    import pickle
+    import subprocess
 
-    t0 = time.perf_counter()
-    threshold = cfg["threshold"]
-    fg = data < threshold
-    dt = ndi.distance_transform_edt(fg).astype(np.float32)
-    hmap = ndi.gaussian_filter(data, cfg["sigma_weights"])
-    height = cfg["alpha"] * hmap + (1 - cfg["alpha"]) * (1 - dt / max(dt.max(), 1e-6))
-    dts = ndi.gaussian_filter(dt, cfg["sigma_seeds"])
-    maxima = (ndi.maximum_filter(dts, size=5) == dts) & fg
-    seeds, _ = ndi.label(maxima)
-    q = (height * 255).astype(np.uint8)
-    ndi.watershed_ift(q, seeds.astype(np.int32))
-    return time.perf_counter() - t0
+    script = os.path.join(workdir, "cpu_chain.py")
+    os.makedirs(workdir, exist_ok=True)
+    bnd_path = os.path.join(workdir, "bnd.npy")
+    np.save(bnd_path, bnd)
+    out_path = os.path.join(workdir, "cpu_result.pkl")
+    with open(script, "w") as f:
+        f.write(f"""
+import os, sys, pickle
+sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
+import numpy as np
+import bench
+bnd = np.load({bnd_path!r})
+bench.run_device_chain(bnd, {os.path.join(workdir, 'warm')!r})
+t, seg = bench.run_device_chain(bnd, {os.path.join(workdir, 'timed')!r})
+with open({out_path!r}, "wb") as fo:
+    pickle.dump((t, seg), fo)
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p)
+    rc = subprocess.call([sys.executable, script], env=env)
+    assert rc == 0, "cpu baseline chain failed"
+    with open(out_path, "rb") as f:
+        return pickle.load(f)
 
 
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    cfg = {"threshold": 0.5, "sigma_seeds": 2.0, "sigma_weights": 2.0,
-           "alpha": 0.8, "size_filter": 0}
-    data = synthetic_boundary_map(SHAPE)
-    n_voxels = int(np.prod(SHAPE))
+    from cluster_tools_tpu.utils.validation import rand_index
 
-    dev_t = bench_device(data, cfg)
-    cpu_t = bench_scipy(data, cfg)
+    lab, bnd = synthetic_instance()
+    n_voxels = int(np.prod(SHAPE))
+    workdir = "/tmp/ctt_bench"
+
+    # first run pays the XLA compiles; report the warm steady state
+    run_device_chain(bnd, workdir)
+    dev_t, dev_seg = run_device_chain(bnd, workdir)
+    cpu_t, cpu_seg = run_cpu_chain(bnd, workdir + "_cpu")
+
+    dev_are, _ = rand_index(dev_seg, lab)
+    cpu_are, _ = rand_index(cpu_seg, lab)
+    print(f"device: {dev_t:.1f}s ARE={dev_are:.4f}; "
+          f"cpu baseline: {cpu_t:.1f}s ARE={cpu_are:.4f}",
+          file=sys.stderr)
+    assert dev_are < 0.1, f"device chain lost parity (ARE {dev_are:.3f})"
+    assert cpu_are < 0.1, f"cpu chain lost parity (ARE {cpu_are:.3f})"
 
     value = n_voxels / dev_t
     baseline = n_voxels / cpu_t
     print(json.dumps({
-        "metric": "dt_watershed_block_throughput",
+        "metric": "multicut_workflow_throughput",
         "value": round(value, 1),
         "unit": "voxels/sec",
         "vs_baseline": round(value / baseline, 3),

@@ -147,8 +147,10 @@ def seeded_watershed(
     labels, _, _ = jax.lax.while_loop(
         flood_cond, flood_body, (labels, jnp.int32(0), jnp.int32(0)))
 
-    # leftovers unreachable by the flood (isolated pockets fully enclosed by
-    # the mask border): unordered sweep, arbitrary-side like any tie
+    # backstop ONLY: the flood converges exactly (its frontier empties), so
+    # this unordered sweep does work solely if the flood's iteration bound
+    # (max_iter + n_levels) was hit early on a pathological instance —
+    # labelable voxels then still get claimed, arbitrary-side like any tie
     def fill_body(state):
         lab, _, it = state
         lab_g = lab.reshape(shape)

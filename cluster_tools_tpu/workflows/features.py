@@ -76,7 +76,7 @@ class BlockEdgeFeatures(BlockTask):
         import jax.numpy as jnp
 
         from ..ops.rag import (affinity_pair_values, boundary_pair_values,
-                               densify_labels, segmented_stats)
+                               densify_labels, device_edge_stats)
 
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
@@ -137,24 +137,24 @@ class BlockEdgeFeatures(BlockTask):
                     inner_begin=tuple(b - bo for b, bo in
                                       zip(block.begin, begin)),
                     inner_shape=tuple(block.shape))
-            m = np.asarray(ok)
-            uv = np.stack([lut[np.asarray(u)[m]], lut[np.asarray(v)[m]]],
-                          axis=1)
-            vals = np.asarray(val)[m].astype("float64")
+            # per-edge reduction ON DEVICE: only the compact (uv, stats)
+            # tables cross the host link (the padded sample arrays are ~10x
+            # the block size — transfer-bound on tunnel-attached chips)
+            uv_dense, edge_feats = device_edge_stats(u, v, val, ok)
+            uv = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]], axis=1)
             if offsets is None:
                 # boundary faces share the RAG's ownership rule, so every
-                # sample maps into the block's own sub-graph
+                # edge maps into the block's own sub-graph
                 local_ids = g.find_edge_ids(edges, uv)
-                feats = segmented_stats(local_ids, vals, len(edges))
+                feats = np.zeros((len(edges), 10), "float64")
+                feats[local_ids] = edge_feats
                 out_ids = edge_ids
             else:
                 # global mapping; long-range pairs that are not RAG edges
                 # anywhere are dropped (strict=False)
                 gids = g.find_edge_ids(global_edges, uv, strict=False)
                 keep = gids >= 0
-                gids, vals = gids[keep], vals[keep]
-                out_ids, local = np.unique(gids, return_inverse=True)
-                feats = segmented_stats(local, vals, len(out_ids))
+                out_ids, feats = gids[keep], edge_feats[keep]
             np.savez(_block_feature_path(cfg["output_path"], block_id),
                      edge_ids=out_ids.astype("int64"), features=feats)
             log_fn(f"processed block {block_id}")

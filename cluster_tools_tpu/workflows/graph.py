@@ -59,7 +59,7 @@ class InitialSubGraphs(BlockTask):
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
         import jax.numpy as jnp
 
-        from ..ops.rag import densify_labels, label_pairs
+        from ..ops.rag import densify_labels, device_unique_edges, label_pairs
 
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
@@ -76,8 +76,11 @@ class InitialSubGraphs(BlockTask):
             u, v, ok = label_pairs(jnp.asarray(dense),
                                    ignore_label=ignore_label,
                                    inner_shape=tuple(block.shape))
-            m = np.asarray(ok)
-            edges = g.unique_edges(lut[np.asarray(u)[m]], lut[np.asarray(v)[m]])
+            # edge dedup ON DEVICE: only the compact edge table crosses the
+            # host link (the padded pair arrays are ~6x the block size)
+            uv_dense = device_unique_edges(u, v, ok)
+            edges = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]],
+                             axis=1).astype("uint64")
             nodes = np.unique(labels)
             if ignore_label:
                 nodes = nodes[nodes != 0]
