@@ -221,9 +221,10 @@ def _edge_stats_device(u, v, values, ok, e_max: int):
 
     num = e_max + 1
     ones = jnp.where(run_id < e_max, 1.0, 0.0)
-    count = jax.ops.segment_sum(ones, run_id, num_segments=num)
+    count = jax.ops.segment_sum(
+        jnp.where(run_id < e_max, 1, 0), run_id,
+        num_segments=num).astype(jnp.float32)
     s1 = jax.ops.segment_sum(x * ones, run_id, num_segments=num)
-    s2 = jax.ops.segment_sum(x * x * ones, run_id, num_segments=num)
     mn = jax.ops.segment_min(jnp.where(run_id < e_max, x, jnp.inf), run_id,
                              num_segments=num)
     mx = jax.ops.segment_max(jnp.where(run_id < e_max, x, -jnp.inf), run_id,
@@ -239,15 +240,23 @@ def _edge_stats_device(u, v, values, ok, e_max: int):
     cnt = count[:e_max]
     denom = jnp.maximum(cnt, 1.0)
     mean = s1[:e_max] / denom
-    var = jnp.maximum(s2[:e_max] / denom - mean ** 2, 0.0)
+    # variance via the centered second pass: the raw sum-of-squares form
+    # cancels catastrophically in float32 for low-variance edges
+    mean_full = jnp.concatenate([mean, jnp.zeros((1,), mean.dtype)])
+    centered = (x - mean_full[run_id]) ** 2
+    s2c = jax.ops.segment_sum(centered * ones, run_id, num_segments=num)
+    var = jnp.maximum(s2c[:e_max] / denom, 0.0)
     sp = start_pos[:e_max]
     last = jnp.clip(sp + cnt.astype(jnp.int32) - 1, 0, n - 1)
     qs = []
     for q in _QS:
-        p = sp + q * (cnt - 1.0)
-        lo = jnp.clip(jnp.floor(p).astype(jnp.int32), 0, n - 1)
+        # keep the base position integral: sp + float(q*(cnt-1)) promotes to
+        # float32 and loses whole indices beyond 2**24 samples
+        qoff = q * (cnt - 1.0)          # bounded by the run length: f32-safe
+        lo_off = jnp.floor(qoff)
+        lo = jnp.clip(sp + lo_off.astype(jnp.int32), 0, n - 1)
         hi = jnp.minimum(lo + 1, last)
-        frac = p - jnp.floor(p)
+        frac = qoff - lo_off
         qs.append(x[lo] * (1.0 - frac) + x[hi] * frac)
     feats = jnp.stack(
         [mean, var, mn[:e_max]] + qs + [mx[:e_max], cnt], axis=1)
@@ -261,7 +270,19 @@ def device_edge_stats(u, v, values, ok, e_max: int = 65536):
 
     Returns (uv [E, 2] int32 dense labels, features [E, 10] float64) with
     E = number of distinct valid edges; raises when the block holds more
-    than ``e_max`` edges (raise e_max or shrink blocks)."""
+    than ``e_max`` edges (raise e_max or shrink blocks).
+
+    Inputs are padded to the next power of two so every (clipped) border
+    block shares one compiled program — per-shape compiles of the sort
+    kernel cost ~a minute each on tunnel-attached devices."""
+    n = int(u.shape[0])
+    n_pad = 1 << max(int(np.ceil(np.log2(max(n, 1)))), 4)
+    if n_pad != n:
+        pad = n_pad - n
+        u = jnp.pad(u, (0, pad))
+        v = jnp.pad(v, (0, pad))
+        values = jnp.pad(values, (0, pad))
+        ok = jnp.pad(ok, (0, pad), constant_values=False)
     uv, feats, n_runs, overflow = _edge_stats_device(u, v, values, ok,
                                                      e_max=e_max)
     if int(overflow) > 0:

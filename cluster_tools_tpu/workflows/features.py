@@ -42,6 +42,14 @@ class BlockEdgeFeatures(BlockTask):
 
     task_name = "block_edge_features"
 
+    @staticmethod
+    def default_task_config():
+        from ..core.runtime import BlockTask
+
+        conf = BlockTask.default_task_config()
+        conf.update({"e_max": 65536})
+        return conf
+
     def __init__(self, input_path: str, input_key: str, labels_path: str,
                  labels_key: str, graph_path: str, output_path: str,
                  offsets: Optional[List[List[int]]] = None,
@@ -140,7 +148,8 @@ class BlockEdgeFeatures(BlockTask):
             # per-edge reduction ON DEVICE: only the compact (uv, stats)
             # tables cross the host link (the padded sample arrays are ~10x
             # the block size — transfer-bound on tunnel-attached chips)
-            uv_dense, edge_feats = device_edge_stats(u, v, val, ok)
+            uv_dense, edge_feats = device_edge_stats(
+                u, v, val, ok, e_max=int(cfg.get("e_max", 65536)))
             uv = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]], axis=1)
             if offsets is None:
                 # boundary faces share the RAG's ownership rule, so every

@@ -78,7 +78,8 @@ class InitialSubGraphs(BlockTask):
                                    inner_shape=tuple(block.shape))
             # edge dedup ON DEVICE: only the compact edge table crosses the
             # host link (the padded pair arrays are ~6x the block size)
-            uv_dense = device_unique_edges(u, v, ok)
+            uv_dense = device_unique_edges(
+                u, v, ok, e_max=int(cfg.get("e_max", 65536)))
             edges = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]],
                              axis=1).astype("uint64")
             nodes = np.unique(labels)

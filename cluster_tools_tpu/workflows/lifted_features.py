@@ -8,10 +8,10 @@ node labels (reference: sparse_lifted_neighborhood.py:107
 costs_from_node_labels.py:119-139, clear_lifted_edges_from_labels.py:83,
 lifted_feature_workflow.py:14-160).
 
-TPU-first design: the BFS-by-depth neighborhood is one sparse boolean
-matrix-power sweep (scipy CSR on host — the RAG is a few-edges-per-node
-graph, so A^d stays sparse); costs are a vectorized label-compare over the
-lifted edge list, sharded over edge chunks.
+TPU-first design: the BFS-by-depth neighborhood is a node-chunked sparse
+boolean matrix sweep (scipy CSR on host, memory bounded by the chunk);
+costs are a vectorized label-compare over the lifted edge list, sharded
+over edge chunks.
 
 Problem-container layout:
 
@@ -54,11 +54,16 @@ def load_edge_list(path: str, key: str) -> np.ndarray:
 
 def lifted_neighborhood(uv_ids: np.ndarray, n_nodes: int, node_labels:
                         np.ndarray, graph_depth: int, mode: str = "all",
-                        ignore_label: int = 0) -> np.ndarray:
+                        ignore_label: int = 0,
+                        node_chunk: int = 100_000) -> np.ndarray:
     """All node pairs with graph distance in [2, graph_depth] whose labels
     pass ``mode`` ('all' | 'same' | 'different'); nodes with the ignore
     label never participate (reference semantics of
-    computeLiftedNeighborhoodFromNodeLabels)."""
+    computeLiftedNeighborhoodFromNodeLabels).
+
+    BFS runs in source-node chunks: global boolean matrix powers densify as
+    degree^depth and would exhaust memory on million-node RAGs; a chunked
+    (n_chunk x n_nodes) indicator sweep bounds peak memory by the chunk."""
     from scipy import sparse
 
     valid = node_labels != ignore_label
@@ -70,22 +75,37 @@ def lifted_neighborhood(uv_ids: np.ndarray, n_nodes: int, node_labels:
     data = np.ones(len(uv), dtype=bool)
     adj = sparse.csr_matrix(
         (data, (uv[:, 0], uv[:, 1])), shape=(n_nodes, n_nodes))
-    adj = adj + adj.T
-    reach = adj.copy()
-    acc = adj.copy()
-    for _ in range(graph_depth - 1):
-        reach = (reach @ adj).astype(bool)
-        acc = (acc + reach).astype(bool)
-    # pairs within depth, minus direct RAG edges, upper triangle
-    acc = sparse.triu(acc, k=1, format="csr")
+    adj = (adj + adj.T).astype(bool)
     direct = sparse.csr_matrix(
         (np.ones(len(uv), bool),
          (np.minimum(uv[:, 0], uv[:, 1]), np.maximum(uv[:, 0], uv[:, 1]))),
-        shape=(n_nodes, n_nodes))
-    lifted = acc.astype("int8") - acc.multiply(direct).astype("int8")
-    lifted.eliminate_zeros()
-    coo = lifted.tocoo()
-    pairs = np.stack([coo.row, coo.col], axis=1).astype("uint64")
+        shape=(n_nodes, n_nodes)).tocsr()
+
+    chunks_out = []
+    for lo in range(0, n_nodes, node_chunk):
+        hi = min(lo + node_chunk, n_nodes)
+        # depth-1 reachability of this source chunk is just a row slice
+        reach = adj[lo:hi].astype(bool).copy()
+        acc = reach.copy()
+        for _ in range(graph_depth - 1):
+            reach = (reach @ adj).astype(bool)
+            acc = (acc + reach).astype(bool)
+        coo = acc.tocoo()
+        rows = coo.row.astype("int64") + lo
+        cols = coo.col.astype("int64")
+        # upper triangle only (each pair reported once globally)
+        m = rows < cols
+        rows, cols = rows[m], cols[m]
+        # minus direct RAG edges
+        if len(rows):
+            is_direct = np.asarray(
+                direct[rows, cols]).ravel().astype(bool)
+            rows, cols = rows[~is_direct], cols[~is_direct]
+        if len(rows):
+            chunks_out.append(
+                np.stack([rows, cols], axis=1).astype("uint64"))
+    pairs = (np.concatenate(chunks_out) if chunks_out
+             else np.zeros((0, 2), "uint64"))
     la = node_labels[pairs[:, 0]]
     lb = node_labels[pairs[:, 1]]
     ok = (la != ignore_label) & (lb != ignore_label)

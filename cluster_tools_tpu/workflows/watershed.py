@@ -28,25 +28,49 @@ from ..core.workflow import Task
 from .relabel import RelabelWorkflow
 
 
-def _read_input(ds, bb, cfg) -> np.ndarray:
-    """Read + normalize boundary evidence; agglomerate affinity channels by
-    mean/max over the configured channel range (reference:
+def _normalize_input(data: np.ndarray, cfg) -> np.ndarray:
+    """Channel agglomeration + range normalization + optional inversion —
+    the single policy shared by every reader (reference:
     watershed.py:267-283 _read_data)."""
-    if ds.ndim == len(bb) + 1:
-        chan = cfg.get("channel_begin", 0), cfg.get("channel_end", None)
-        cb = chan[0]
-        ce = ds.shape[0] if chan[1] is None else chan[1]
-        data = ds[(slice(cb, ce),) + bb].astype("float32")
+    if data.ndim == 4:
         agglo = cfg.get("agglomerate_channels", "mean")
         data = data.max(axis=0) if agglo == "max" else data.mean(axis=0)
-    else:
-        data = ds[bb].astype("float32")
     mx = data.max()
     if mx > 1.0:
         data = data / 255.0 if mx <= 255 else data / mx
     if cfg.get("invert_inputs", False):
         data = 1.0 - data
     return data
+
+
+def _channel_slice(ds, cfg):
+    cb = cfg.get("channel_begin", 0)
+    ce = cfg.get("channel_end", None)
+    return slice(cb, ds.shape[0] if ce is None else ce)
+
+
+def _read_input(ds, bb, cfg) -> np.ndarray:
+    """Read + normalize boundary evidence (clipped bounding-box variant)."""
+    if ds.ndim == len(bb) + 1:
+        data = ds[(_channel_slice(ds, cfg),) + bb].astype("float32")
+    else:
+        data = ds[bb].astype("float32")
+    return _normalize_input(data, cfg)
+
+
+def _read_padded_input(ds, block, cfg, halo) -> np.ndarray:
+    """Read the block at the uniform outer shape (reflect-padded at volume
+    borders), same normalization policy as _read_input."""
+    from .inference import load_with_halo
+
+    if ds.ndim == len(block.begin) + 1:
+        data = load_with_halo(
+            ds, block.begin, cfg["block_shape"], halo,
+            channel_slice=_channel_slice(ds, cfg)).astype("float32")
+    else:
+        data = load_with_halo(ds, block.begin, cfg["block_shape"],
+                              halo).astype("float32")
+    return _normalize_input(data, cfg)
 
 
 def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
@@ -370,35 +394,59 @@ class WatershedTask(BlockTask):
 
         label_offset_unit = np.uint64(np.prod(cfg["block_shape"]))
         seeded = cfg.get("seeded", False)
+        # blocks are loaded at the UNIFORM outer shape (volume borders
+        # reflect-padded, like the inference task): every block shares one
+        # compiled device program instead of one per clipped border shape
+        # (per-shape compiles cost ~a minute each on tunnel-attached chips)
+        from .inference import load_with_halo
+
+        outer_shape = tuple(b + 2 * h
+                            for b, h in zip(cfg["block_shape"], halo))
         for block_id in job_config["block_list"]:
+            block = blocking.get_block(block_id)
             bh = blocking.get_block_with_halo(block_id, halo)
-            data = _read_input(ds_in, bh.outer.bb, cfg)
+            data = _read_padded_input(ds_in, block, cfg, halo)
             bmask = None
             if mask is not None:
-                bmask = np.asarray(mask[bh.outer.bb]) > 0
-                if not bmask.any():
+                m = np.asarray(mask[bh.outer.bb]) > 0
+                if not m.any():
                     log_fn(f"processed block {block_id}")
                     continue
+                # edge-replicate onto the uniform frame (same geometry the
+                # reflect-padded data read uses)
+                lo_pad = [h - (b - o.start)
+                          for h, b, o in zip(halo, block.begin, bh.outer.bb)]
+                hi_pad = [os_ - lp - (o.stop - o.start)
+                          for os_, lp, o in zip(outer_shape, lo_pad,
+                                                bh.outer.bb)]
+                bmask = np.pad(m, list(zip(lo_pad, hi_pad)), mode="edge")
+            # actual (clipped) inner extent within the uniform frame
+            inner_sl = tuple(slice(h, h + (b.stop - b.start))
+                             for h, b in zip(halo, block.bb))
             if seeded:
                 # pass-2: labels already written by the other checkerboard
                 # color act as seeds; same-color owners (possibly being
                 # written concurrently) are masked out so the result is
-                # order-independent
-                seeds = np.asarray(ds_out[bh.outer.bb])
+                # order-independent.  Seeds pad with 0 (reflecting would
+                # duplicate label ids).
+                seeds = load_with_halo(ds_out, block.begin,
+                                       cfg["block_shape"], halo,
+                                       padding_mode="constant")
                 own_color = sum(blocking.block_grid_position(block_id)) % 2
                 grids = np.meshgrid(
-                    *[np.arange(b.start, b.stop) // bs
-                      for b, bs in zip(bh.outer.bb, cfg["block_shape"])],
+                    *[(np.arange(b - h, b - h + o)) // bs
+                      for b, h, o, bs in zip(block.begin, halo, outer_shape,
+                                             cfg["block_shape"])],
                     indexing="ij")
                 seeds[sum(grids) % 2 == own_color] = 0
                 ws = run_ws_block_seeded(
                     data, {**cfg, "id_budget": int(label_offset_unit)}, seeds,
                     int(np.uint64(block_id) * label_offset_unit), bmask)
-                ds_out[bh.inner.bb] = ws[bh.inner_local.bb]
+                ds_out[block.bb] = ws[inner_sl]
                 log_fn(f"processed block {block_id}")
                 continue
             ws = run_ws_block(data, cfg, bmask)
-            inner = ws[bh.inner_local.bb]
+            inner = ws[inner_sl]
             # compact to 1..k (k <= inner voxel count < offset unit), THEN
             # offset for global uniqueness (reference: watershed.py:307) —
             # uncompacted CC root indices range over the larger outer block
@@ -408,7 +456,7 @@ class WatershedTask(BlockTask):
             compact[inner == 0] = 0
             compact = np.where(
                 compact > 0, compact + np.uint64(block_id) * label_offset_unit, 0)
-            ds_out[bh.inner.bb] = compact
+            ds_out[block.bb] = compact
             log_fn(f"processed block {block_id}")
 
 
