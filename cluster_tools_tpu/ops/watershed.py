@@ -19,7 +19,6 @@ between implementations, as they already do between vigra and scipy.
 from __future__ import annotations
 
 from functools import partial
-from itertools import product
 from typing import Optional, Tuple
 
 import jax

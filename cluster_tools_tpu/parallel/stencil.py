@@ -17,12 +17,11 @@ reflect/constant padding at volume borders).
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Optional, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _take(x: jnp.ndarray, axis: int, sl: slice) -> jnp.ndarray:

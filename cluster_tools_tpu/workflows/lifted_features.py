@@ -26,7 +26,6 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from ..core.blocking import Blocking
 from ..core.runtime import BlockTask
 from ..core.storage import file_reader
 from ..core.workflow import FileTarget, Task

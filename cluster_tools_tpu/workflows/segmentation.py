@@ -11,7 +11,6 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
-from ..core.storage import file_reader
 from ..core.workflow import FileTarget, Task
 from .costs import EdgeCostsWorkflow
 from .features import EdgeFeaturesWorkflow
