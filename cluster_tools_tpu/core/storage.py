@@ -63,7 +63,11 @@ class AttrsView:
 
     _N5_RESERVED = {"dimensions", "blockSize", "dataType", "compression"}
 
-    def __init__(self, path: str, flavor: str):
+    def __init__(self, path: str, flavor: str, is_dataset: bool = False):
+        # the reserved-key guard protects N5 *array* metadata only; group
+        # attributes legitimately use these names (e.g. bdv.n5 setup-level
+        # ``dataType``)
+        self._guard = flavor == "n5" and is_dataset
         self._flavor = flavor
         if flavor == "zarr":
             self._file = os.path.join(path, ".zattrs")
@@ -88,7 +92,7 @@ class AttrsView:
         return self._load()[key]
 
     def __setitem__(self, key: str, value: Any) -> None:
-        if self._flavor == "n5" and key in self._N5_RESERVED:
+        if self._guard and key in self._N5_RESERVED:
             raise KeyError(f"{key} is reserved N5 metadata")
         with self._lock:
             data = self._load()
@@ -127,7 +131,7 @@ class Dataset:
         self._store = store
         self.path = path
         self.flavor = flavor
-        self.attrs = AttrsView(path, flavor)
+        self.attrs = AttrsView(path, flavor, is_dataset=True)
         self.n_threads = 1
 
     @property

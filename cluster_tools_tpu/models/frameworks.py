@@ -1,0 +1,82 @@
+"""Predictor framework registry for the inference workflow.
+
+Re-specification of the reference's framework dispatch
+(reference: inference/frameworks.py:118-130 ``get_predictor``, :32-87
+``PytorchPredicter``).  Two frameworks:
+
+* ``'self'`` — first-party flax checkpoints (models/checkpoint.py), run as
+  one jitted XLA program on the device.  This is the TPU path and the
+  default.
+* ``'pytorch'`` — externally-trained torch models (``torch.load``-able
+  ``nn.Module``), run on the host CPU.  Kept for parity with the
+  reference's ability to consume torch checkpoints trained elsewhere; the
+  forward pass is lock-serialized exactly like the reference's GPU path so
+  the surrounding IO threads never re-enter the model.
+
+Every predictor maps one raw outer block (``(*outer_shape)`` or
+``(C, *outer_shape)``) to a channels-first, halo-cropped float32 prediction
+``(C_out, *inner_shape)``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+import numpy as np
+
+
+def make_torch_predictor(checkpoint_path: str, outer_shape: Sequence[int],
+                         halo: Sequence[int],
+                         preprocess: str = "standardize"):
+    """Host-CPU predictor over a ``torch.load``-able module (reference:
+    inference/frameworks.py:32-87)."""
+    import torch
+
+    model = torch.load(checkpoint_path, map_location="cpu",
+                       weights_only=False)
+    model.eval()
+    lock = threading.Lock()
+    inner = tuple(slice(h, s - h) for s, h in zip(outer_shape, halo))
+    ndim = len(outer_shape)
+
+    def predict(block: np.ndarray) -> np.ndarray:
+        x = np.asarray(block).astype("float32")
+        if x.ndim == ndim:  # single channel -> (C=1, *outer)
+            x = x[None]
+        spatial = tuple(range(1, x.ndim))
+        # per-channel statistics, matching the 'self' predictor and the
+        # reference preprocessor (inference/frameworks.py:137-161)
+        if preprocess == "standardize":
+            mean = x.mean(axis=spatial, keepdims=True)
+            std = np.maximum(x.std(axis=spatial, keepdims=True), 1e-6)
+            x = (x - mean) / std
+        elif preprocess == "normalize":
+            lo = x.min(axis=spatial, keepdims=True)
+            hi = x.max(axis=spatial, keepdims=True)
+            x = (x - lo) / np.maximum(hi - lo, 1e-6)
+        with lock, torch.no_grad():
+            out = model(torch.from_numpy(x[None]))
+            if isinstance(out, tuple):
+                out = out[0]
+            out = out.numpy()[0]
+        if out.ndim == ndim:
+            out = out[None]
+        return out[(slice(None),) + inner].astype("float32")
+
+    return predict
+
+
+def get_predictor(framework: str, checkpoint_path: str,
+                  outer_shape: Sequence[int], halo: Sequence[int],
+                  preprocess: str = "standardize"):
+    """Framework dispatch (reference: inference/frameworks.py:118-130)."""
+    if framework == "self":
+        from ..workflows.inference import make_predictor
+
+        return make_predictor(checkpoint_path, outer_shape, halo, preprocess)
+    if framework == "pytorch":
+        return make_torch_predictor(checkpoint_path, outer_shape, halo,
+                                    preprocess)
+    raise KeyError(f"Framework {framework} not supported "
+                   "(available: 'self', 'pytorch')")

@@ -24,7 +24,7 @@ from .lifted_multicut import LiftedMulticutWorkflow
 from .morphology import MorphologyWorkflow
 from .postprocess import FilterByThresholdWorkflow
 from .region_features import RegionFeaturesWorkflow
-from .skeletons import SkeletonWorkflow
+from .skeletons import SkeletonWorkflow, UpsampleSkeletons
 from .relabel import RelabelWorkflow
 from .segmentation import (AgglomerativeClusteringWorkflow,
                            LiftedMulticutSegmentationWorkflow,
@@ -48,6 +48,7 @@ __all__ = [
     "LearningWorkflow",
     "LiftedFeaturesFromNodeLabelsWorkflow",
     "MorphologyWorkflow", "RegionFeaturesWorkflow", "SkeletonWorkflow",
+    "UpsampleSkeletons",
     "LiftedMulticutSegmentationWorkflow", "LiftedMulticutWorkflow",
     "MulticutWorkflow", "MwsWorkflow", "TwoPassMwsWorkflow",
     "SimpleStitchingWorkflow",

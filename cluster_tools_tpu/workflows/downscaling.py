@@ -189,45 +189,118 @@ def f_dtype(path: str, key: str):
 
 class WriteDownscalingMetadata(Task):
     """Multiscale metadata: per-level downsamplingFactors + group attrs
-    (reference: downscaling_workflow.py:33-215, paintera format)."""
+    (reference: downscaling_workflow.py:33-215).
+
+    ``metadata_format``: ``'paintera'`` (default — multiScale group attrs,
+    XYZ axis order) or ``'bdv'`` (bdv.n5 setup-level attrs + a BigDataViewer
+    SpimData XML sidecar next to the container, reference:
+    downscaling_workflow.py:97-202 ``_write_bdv_xml``).  For ``'bdv'`` the
+    pyramid must use the bdv.n5 layout ``setup{i}/timepoint{t}/s{L}`` —
+    i.e. pass ``output_key_prefix='setup0/timepoint0'`` — so
+    BigDataViewer's n5 backend can resolve the scale datasets; the required
+    ``downsamplingFactors``/``dataType`` attributes are written on the
+    setup group."""
 
     def __init__(self, tmp_folder: str, output_path: str, scale_factors,
                  output_key_prefix: str = "", metadata_dict=None,
-                 scale_offset: int = 0, dependency: Optional[Task] = None):
+                 scale_offset: int = 0, metadata_format: str = "paintera",
+                 dependency: Optional[Task] = None):
+        assert metadata_format in ("paintera", "bdv"), metadata_format
+        # the bdv factor list and XML size are absolute (relative to s0);
+        # with an offset the factors below it are unknown to this task
+        if metadata_format == "bdv" and scale_offset != 0:
+            raise ValueError("metadata_format='bdv' requires scale_offset=0")
         self.tmp_folder = tmp_folder
         self.output_path = output_path
         self.scale_factors = [_factor3(s) for s in scale_factors]
         self.output_key_prefix = output_key_prefix
         self.metadata_dict = dict(metadata_dict or {})
         self.scale_offset = scale_offset
+        self.metadata_format = metadata_format
         self.dependency = dependency
         super().__init__()
 
     def requires(self):
         return self.dependency
 
+    def _write_bdv_xml(self, shape) -> None:
+        """SpimData XML sidecar: sizes, voxel resolution and the affine
+        placing the volume in world space (one channel / one timepoint, like
+        the reference)."""
+        import xml.etree.ElementTree as ET
+
+        nz, ny, nx = [int(s) for s in shape]
+        dz, dy, dx = [float(r) for r in
+                      self.metadata_dict.get("resolution", [1.0] * 3)]
+        oz, oy, ox = [float(o) for o in
+                      self.metadata_dict.get("offsets", [0.0] * 3)]
+        unit = self.metadata_dict.get("unit", "micrometer")
+
+        root = ET.Element("SpimData", version="0.2")
+        ET.SubElement(root, "BasePath", type="relative").text = "."
+        seq = ET.SubElement(root, "SequenceDescription")
+        loader = ET.SubElement(seq, "ImageLoader", format="bdv.n5")
+        ET.SubElement(loader, "n5", type="relative").text = \
+            os.path.basename(self.output_path)
+        views = ET.SubElement(seq, "ViewSetups")
+        setup = ET.SubElement(views, "ViewSetup")
+        ET.SubElement(setup, "id").text = "0"
+        ET.SubElement(setup, "name").text = "channel 1"
+        ET.SubElement(setup, "size").text = f"{nx} {ny} {nz}"
+        vox = ET.SubElement(setup, "voxelSize")
+        ET.SubElement(vox, "unit").text = unit
+        ET.SubElement(vox, "size").text = f"{dx} {dy} {dz}"
+        tp = ET.SubElement(seq, "Timepoints", type="range")
+        ET.SubElement(tp, "first").text = "0"
+        ET.SubElement(tp, "last").text = "0"
+        regs = ET.SubElement(root, "ViewRegistrations")
+        reg = ET.SubElement(regs, "ViewRegistration", timepoint="0",
+                            setup="0")
+        vt = ET.SubElement(reg, "ViewTransform", type="affine")
+        ET.SubElement(vt, "affine").text = (
+            f"{dx} 0.0 0.0 {ox} 0.0 {dy} 0.0 {oy} 0.0 0.0 {dz} {oz}")
+        xml_path = os.path.splitext(self.output_path.rstrip("/"))[0] + ".xml"
+        ET.ElementTree(root).write(xml_path)
+
     def run(self):
         effective = [1, 1, 1]
+        all_factors = [[1, 1, 1]]  # XYZ, s0 included (bdv.n5 convention)
         with file_reader(self.output_path) as f:
             for scale, factor in enumerate(self.scale_factors):
                 key = os.path.join(self.output_key_prefix,
                                    f"s{scale + self.scale_offset + 1}")
                 effective = [e * s for e, s in zip(effective, factor)]
-                # paintera axis order is XYZ; ours is ZYX -> reverse
+                # paintera/bdv axis order is XYZ; ours is ZYX -> reverse
                 f[key].attrs["downsamplingFactors"] = effective[::-1]
-            group = (f.require_group(self.output_key_prefix)
-                     if self.output_key_prefix else f)
-            group.attrs["multiScale"] = True
-            group.attrs["resolution"] = list(
-                self.metadata_dict.get("resolution", [1.0] * 3))[::-1]
-            group.attrs["offset"] = list(
-                self.metadata_dict.get("offsets", [0.0] * 3))[::-1]
-            # propagate maxId from level 0 if present
+                all_factors.append(effective[::-1])
             level0 = os.path.join(self.output_key_prefix,
                                   f"s{self.scale_offset}")
             max_id = f[level0].attrs.get("maxId")
-            if max_id is not None:
-                group.attrs["maxId"] = int(max_id)
+            if self.metadata_format == "paintera":
+                group = (f.require_group(self.output_key_prefix)
+                         if self.output_key_prefix else f)
+                group.attrs["multiScale"] = True
+                group.attrs["resolution"] = list(
+                    self.metadata_dict.get("resolution", [1.0] * 3))[::-1]
+                group.attrs["offset"] = list(
+                    self.metadata_dict.get("offsets", [0.0] * 3))[::-1]
+                if max_id is not None:
+                    group.attrs["maxId"] = int(max_id)
+            else:  # bdv.n5: setup-level attrs + SpimData XML sidecar
+                # the pyramid lives at setup{i}/timepoint{t}/s{L}; the
+                # attrs BigDataViewer's n5 backend requires go on the
+                # *setup* group (parent of the timepoint group)
+                setup_key = os.path.dirname(self.output_key_prefix)
+                setup = (f.require_group(setup_key) if setup_key else
+                         (f.require_group(self.output_key_prefix)
+                          if self.output_key_prefix else f))
+                setup.attrs["downsamplingFactors"] = all_factors
+                setup.attrs["dataType"] = str(f[level0].dtype)
+                if max_id is not None:
+                    setup.attrs["maxId"] = int(max_id)
+                shape = f[level0].shape
+        if self.metadata_format == "bdv":
+            self._write_bdv_xml(shape)
         self.output().touch()
 
     def output(self):
@@ -244,12 +317,14 @@ class DownscalingWorkflow(Task):
                  scale_factors: Sequence[ScaleFactor], tmp_folder: str,
                  config_dir: str, max_jobs: int = 1, target: str = "local",
                  output_key_prefix: str = "", metadata_dict=None,
+                 metadata_format: str = "paintera",
                  dependency: Optional[Task] = None):
         self.input_path = input_path
         self.input_key = input_key
         self.scale_factors = list(scale_factors)
         self.output_key_prefix = output_key_prefix
         self.metadata_dict = metadata_dict or {}
+        self.metadata_format = metadata_format
         self.tmp_folder = tmp_folder
         self.config_dir = config_dir
         self.max_jobs = max_jobs
@@ -278,7 +353,8 @@ class DownscalingWorkflow(Task):
             tmp_folder=self.tmp_folder, output_path=self.input_path,
             scale_factors=self.scale_factors,
             output_key_prefix=self.output_key_prefix,
-            metadata_dict=self.metadata_dict, dependency=dep)
+            metadata_dict=self.metadata_dict,
+            metadata_format=self.metadata_format, dependency=dep)
 
     def output(self):
         return FileTarget(os.path.join(self.tmp_folder,

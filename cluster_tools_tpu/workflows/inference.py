@@ -6,11 +6,13 @@ dask-delayed load->preprocess->predict->write pipeline overlapping IO and GPU
 :244-343, multi-dataset channel mapping :87-104, uint8 requantization
 :235-241, mask-skip :268-276).  Differences by design:
 
-* The model is first-party (flax 3D U-Net, models/unet.py) loaded from a
-  framework checkpoint (models/checkpoint.py) instead of an external torch
-  pickle; the forward pass is one jitted XLA program compiled once per job —
-  every block has the same padded outer shape, so there is exactly one
-  compilation.
+* The default model is first-party (flax 3D U-Net, models/unet.py) loaded
+  from a framework checkpoint (models/checkpoint.py); the forward pass is
+  one jitted XLA program compiled once per job — every block has the same
+  padded outer shape, so there is exactly one compilation.  Externally
+  trained torch checkpoints remain loadable via the framework registry
+  (config ``framework='pytorch'``, models/frameworks.py — the reference's
+  inference/frameworks.py dispatch).
 * Input normalization (zero-mean/unit-variance, the reference's preprocessor
   — inference/frameworks.py:137-161) and the reflect-padding up to the
   U-Net's divisibility constraint are fused *into* the jitted program: the
@@ -159,6 +161,7 @@ class InferenceTask(BlockTask):
     def default_task_config():
         conf = BlockTask.default_task_config()
         conf.update({"dtype": "uint8", "preprocess": "standardize",
+                     "framework": "self",
                      "channel_begin": 0, "channel_end": None})
         return conf
 
@@ -211,9 +214,12 @@ class InferenceTask(BlockTask):
 
             mask = load_mask(cfg["mask_path"], cfg["mask_key"], shape)
 
+        from ..models.frameworks import get_predictor
+
         outer_shape = tuple(bs + 2 * h for bs, h in zip(block_shape, halo))
-        predict = make_predictor(cfg["checkpoint_path"], outer_shape, halo,
-                                 cfg.get("preprocess", "standardize"))
+        predict = get_predictor(cfg.get("framework", "self"),
+                                cfg["checkpoint_path"], outer_shape, halo,
+                                cfg.get("preprocess", "standardize"))
         n_threads = int(cfg.get("threads_per_job", 1)) or 1
 
         # channel selection for 4D (C, Z, Y, X) inputs (reference channel

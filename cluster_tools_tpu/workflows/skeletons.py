@@ -112,6 +112,104 @@ def load_skeleton(output_path: str, output_key: str,
     return flat.reshape(-1, 3)
 
 
+class UpsampleSkeletons(BlockTask):
+    """Map skeletons computed on a downscaled grid to full resolution
+    (reference: upsample_skeletons.py:117-168 — left unfinished upstream
+    with TODOs; this is a working equivalent fitted to our coordinate-list
+    skeleton storage).  Coordinates are scaled by ``scale_factor`` and,
+    when a full-res segmentation is given, filtered to voxels that still
+    carry the skeleton's label (so upsampled nodes never leave the
+    object)."""
+
+    task_name = "upsample_skeletons"
+
+    def __init__(self, skeleton_path: str, skeleton_key: str,
+                 output_path: str, output_key: str, scale_factor,
+                 n_labels: int, seg_path: str = "", seg_key: str = "", **kw):
+        self.skeleton_path = skeleton_path
+        self.skeleton_key = skeleton_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.scale_factor = ([scale_factor] * 3
+                             if isinstance(scale_factor, int)
+                             else [int(s) for s in scale_factor])
+        self.n_labels = n_labels
+        self.seg_path = seg_path
+        self.seg_key = seg_key
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"id_chunk_size": 1000})
+        return conf
+
+    def run_impl(self):
+        chunk = int(self.task_config.get("id_chunk_size", 1000))
+        self.run_jobs(self.id_chunks(self.n_labels, chunk), {
+            "skeleton_path": self.skeleton_path,
+            "skeleton_key": self.skeleton_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "scale_factor": self.scale_factor, "n_labels": self.n_labels,
+            "seg_path": self.seg_path, "seg_key": self.seg_key,
+            "id_chunk_size": chunk,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        chunk, n_labels = cfg["id_chunk_size"], cfg["n_labels"]
+        factor = np.asarray(cfg["scale_factor"], "uint64")
+        src = VarlenDataset(os.path.join(cfg["skeleton_path"],
+                                         cfg["skeleton_key"]),
+                            dtype="uint64")
+        out = VarlenDataset(os.path.join(cfg["output_path"],
+                                         cfg["output_key"]), dtype="uint64")
+        ds_seg = None
+        if cfg.get("seg_path"):
+            f_seg = file_reader(cfg["seg_path"], "r")
+            ds_seg = f_seg[cfg["seg_key"]]
+
+        for block_id in job_config["block_list"]:
+            lo, hi = block_id * chunk, min((block_id + 1) * chunk, n_labels)
+            for label_id in range(max(lo, 1), hi):
+                flat = src.read_chunk((label_id,))
+                if flat is None or flat.size == 0:
+                    continue
+                coords = flat.reshape(-1, 3) * factor[None]
+                if ds_seg is not None:
+                    coords = cls._filter_to_object(ds_seg, coords, label_id)
+                out.write_chunk((label_id,), coords.ravel())
+            log_fn(f"processed block {block_id}")
+
+    @staticmethod
+    def _filter_to_object(ds_seg, coords: np.ndarray,
+                          label_id: int) -> np.ndarray:
+        """Keep only in-bounds coordinates whose full-res segmentation voxel
+        carries ``label_id``.  The lookup is tiled over fixed windows so an
+        elongated skeleton never forces one dense read of its whole
+        (possibly volume-spanning) bounding box."""
+        tile = np.asarray(
+            getattr(ds_seg, "chunks", None) or (64, 64, 64), "int64")[-3:]
+        shape = np.asarray(ds_seg.shape[-3:], "int64")
+        c = coords.astype("int64")
+        in_bounds = (c < shape[None]).all(axis=1)
+        c = c[in_bounds]
+        coords = coords[in_bounds]
+        if len(c) == 0:
+            return coords
+        keep = np.zeros(len(c), bool)
+        tiles, inv = np.unique(c // tile[None], axis=0, return_inverse=True)
+        for i, tid in enumerate(tiles):
+            sel = inv == i
+            blo = tid * tile
+            bhi = np.minimum(blo + tile, shape)
+            sub = np.asarray(ds_seg[tuple(slice(a, b)
+                                          for a, b in zip(blo, bhi))])
+            keep[sel] = sub[tuple((c[sel] - blo).T)] == label_id
+        return coords[keep]
+
+
 class SkeletonEvaluation(BlockTask):
     """Skeleton-based split/merge metrics vs a segmentation (reference:
     skeleton_evaluation.py:96 via nifty SkeletonMetrics): for each skeleton,

@@ -184,3 +184,46 @@ def test_bigcat_export(tmp_workdir, tmp_path):
         assert "next_id" in f.attrs
     np.testing.assert_array_equal(frags, seg)
     assert lut.shape[0] == 2
+
+
+def test_downscaling_bdv_metadata(tmp_workdir, tmp_path):
+    """metadata_format='bdv' writes a SpimData XML sidecar with the level-0
+    size and resolution affine (reference: downscaling_workflow.py:97-202)."""
+    import xml.etree.ElementTree as ET
+
+    from cluster_tools_tpu.workflows.downscaling import DownscalingWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (8, 16, 16)
+    vol = np.random.RandomState(1).rand(*shape).astype("float32")
+    path = str(tmp_path / "bdv.n5")
+    with file_reader(path) as f:
+        f.create_dataset("setup0/timepoint0/s0", data=vol, chunks=[8, 8, 8])
+
+    wf = DownscalingWorkflow(
+        input_path=path, input_key="setup0/timepoint0/s0",
+        scale_factors=[[2, 2, 2]], output_key_prefix="setup0/timepoint0",
+        metadata_dict={"resolution": [40.0, 4.0, 4.0],
+                       "offsets": [0.0, 8.0, 8.0], "unit": "nanometer"},
+        metadata_format="bdv",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    xml_path = str(tmp_path / "bdv.xml")
+    root = ET.parse(xml_path).getroot()
+    assert root.tag == "SpimData"
+    size = root.find(".//ViewSetup/size").text.split()
+    assert [int(s) for s in size] == [16, 16, 8]  # XYZ order
+    vox = root.find(".//voxelSize/size").text.split()
+    assert [float(v) for v in vox] == [4.0, 4.0, 40.0]
+    assert root.find(".//voxelSize/unit").text == "nanometer"
+    affine = [float(a) for a in root.find(".//affine").text.split()]
+    assert affine[0] == 4.0 and affine[5] == 4.0 and affine[10] == 40.0
+    assert affine[7] == 8.0 and affine[11] == 0.0
+    # bdv.n5 attrs live on the setup group: all scales incl s0, XYZ order
+    with file_reader(path, "r") as f:
+        setup_attrs = f.require_group("setup0").attrs
+        assert setup_attrs["downsamplingFactors"] == [[1, 1, 1], [2, 2, 2]]
+        assert setup_attrs["dataType"] == "float32"
+        assert f["setup0/timepoint0/s1"].shape == (4, 8, 8)

@@ -301,3 +301,44 @@ def test_edge_costs_with_rf(tmp_workdir, tmp_path):
     # cut edges (label 1, high RF prob) must be repulsive, merge attractive
     assert (costs[labels == 1] < 0).mean() > 0.9
     assert (costs[labels == 0] > 0).mean() > 0.9
+
+
+def test_upsample_skeletons(tmp_workdir, tmp_path):
+    """Skeletons computed on a 2x-downscaled grid map back onto the full-res
+    object (reference: upsample_skeletons.py — unfinished upstream; our
+    working equivalent scales coordinates and snaps them to the object)."""
+    from cluster_tools_tpu.workflows.skeletons import (SkeletonWorkflow,
+                                                       UpsampleSkeletons,
+                                                       load_skeleton)
+
+    tmp_folder, config_dir = tmp_workdir
+    # full-res bar and its 2x-downscaled version
+    seg = np.zeros((16, 16, 48), "uint64")
+    seg[4:12, 4:12, 4:44] = 1
+    ds_seg = seg[::2, ::2, ::2]
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("seg", data=seg, chunks=[16, 16, 16])
+        small = f.create_dataset("seg_s1", data=ds_seg, chunks=[8, 8, 8])
+        small.attrs["maxId"] = 1
+
+    wf = SkeletonWorkflow(
+        input_path=path, input_key="seg_s1", output_path=path,
+        output_key="skel_s1", tmp_folder=tmp_folder,
+        config_dir=config_dir, max_jobs=1, target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    up = UpsampleSkeletons(
+        skeleton_path=path, skeleton_key="skel_s1",
+        output_path=path, output_key="skel_s0",
+        scale_factor=2, n_labels=2, seg_path=path, seg_key="seg",
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=1,
+        target="threads")
+    assert build([up], raise_on_failure=True)
+
+    lo = load_skeleton(path, "skel_s1", 1)
+    hi = load_skeleton(path, "skel_s0", 1)
+    assert hi is not None and len(hi) > 0 and len(hi) <= len(lo)
+    # upsampled coordinates live on the full-res grid, inside the object
+    assert hi[:, 2].max() > ds_seg.shape[2]
+    assert (seg[tuple(hi.T.astype("int64"))] == 1).all()
