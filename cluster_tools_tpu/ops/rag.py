@@ -347,43 +347,56 @@ def segmented_stats(edge_index: np.ndarray, values: np.ndarray,
 
 
 def merge_feature_blocks(partials: Sequence[Tuple[np.ndarray, np.ndarray]],
-                         n_edges: int) -> np.ndarray:
+                         n_edges: int, n_feats: int = N_FEATURES
+                         ) -> np.ndarray:
     """Combine per-block feature rows into global per-edge features.
 
-    ``partials`` = iterable of (edge_ids, features[E_b, 10]).  Mean/variance
-    merge exactly (count-weighted moments); min/max elementwise; quantiles
-    merge as count-weighted means — an approximation (exact distributed
-    quantiles would need the raw samples; the reference's C++ merge makes the
-    same trade, nifty mergeFeatureBlocks).
+    ``partials`` = iterable of (edge_ids, features[E_b, n_feats]), where the
+    columns are one or more 9-wide stat groups ([mean, variance, min,
+    q10, q25, q50, q75, q90, max] — one group per filter response in the
+    filter-bank features path) followed by a single shared sample-count
+    column.  Mean/variance merge exactly (count-weighted moments); min/max
+    elementwise; quantiles merge as count-weighted means — an approximation
+    (exact distributed quantiles would need the raw samples; the reference's
+    C++ merge makes the same trade, nifty mergeFeatureBlocks).
     """
+    n_groups = (n_feats - 1) // 9
+    assert n_groups * 9 + 1 == n_feats, n_feats
     cnt = np.zeros(n_edges, "float64")
-    s1 = np.zeros(n_edges, "float64")        # Σ w·mean
-    s2 = np.zeros(n_edges, "float64")        # Σ w·(var + mean²)
-    mn = np.full(n_edges, np.inf)
-    mx = np.full(n_edges, -np.inf)
-    qs = np.zeros((n_edges, len(_QS)), "float64")
+    s1 = np.zeros((n_edges, n_groups), "float64")    # Σ w·mean
+    s2 = np.zeros((n_edges, n_groups), "float64")    # Σ w·(var + mean²)
+    mn = np.full((n_edges, n_groups), np.inf)
+    mx = np.full((n_edges, n_groups), -np.inf)
+    qs = np.zeros((n_edges, n_groups, len(_QS)), "float64")
     for edge_ids, feats in partials:
         # zero-count rows (edges with no samples in this block) must not
         # pollute min/max/moments
-        nz = feats[:, 9] > 0
+        nz = feats[:, -1] > 0
         edge_ids, feats = edge_ids[nz], feats[nz]
         if len(edge_ids) == 0:
             continue
-        w = feats[:, 9]
+        w = feats[:, -1]
         np.add.at(cnt, edge_ids, w)
-        np.add.at(s1, edge_ids, w * feats[:, 0])
-        np.add.at(s2, edge_ids, w * (feats[:, 1] + feats[:, 0] ** 2))
-        np.minimum.at(mn, edge_ids, feats[:, 2])
-        np.maximum.at(mx, edge_ids, feats[:, 8])
-        for qi in range(len(_QS)):
-            np.add.at(qs[:, qi], edge_ids, w * feats[:, 3 + qi])
-    out = np.zeros((n_edges, N_FEATURES), "float64")
+        for gi in range(n_groups):
+            base = 9 * gi
+            np.add.at(s1[:, gi], edge_ids, w * feats[:, base])
+            np.add.at(s2[:, gi], edge_ids,
+                      w * (feats[:, base + 1] + feats[:, base] ** 2))
+            np.minimum.at(mn[:, gi], edge_ids, feats[:, base + 2])
+            np.maximum.at(mx[:, gi], edge_ids, feats[:, base + 8])
+            for qi in range(len(_QS)):
+                np.add.at(qs[:, gi, qi], edge_ids,
+                          w * feats[:, base + 3 + qi])
+    out = np.zeros((n_edges, n_feats), "float64")
     nz = cnt > 0
-    out[nz, 0] = s1[nz] / cnt[nz]
-    out[nz, 1] = np.maximum(s2[nz] / cnt[nz] - out[nz, 0] ** 2, 0.0)
-    out[nz, 2] = mn[nz]
-    out[nz, 8] = mx[nz]
-    for qi in range(len(_QS)):
-        out[nz, 3 + qi] = qs[nz, qi] / cnt[nz]
-    out[:, 9] = cnt
+    for gi in range(n_groups):
+        base = 9 * gi
+        out[nz, base] = s1[nz, gi] / cnt[nz]
+        out[nz, base + 1] = np.maximum(
+            s2[nz, gi] / cnt[nz] - out[nz, base] ** 2, 0.0)
+        out[nz, base + 2] = mn[nz, gi]
+        out[nz, base + 8] = mx[nz, gi]
+        for qi in range(len(_QS)):
+            out[nz, base + 3 + qi] = qs[nz, gi, qi] / cnt[nz]
+    out[:, -1] = cnt
     return out

@@ -11,7 +11,8 @@ from .masking import BlocksFromMask, MinFilterMask
 from .meshes import MeshWorkflow
 from .paintera import BigcatWorkflow, PainteraConversionWorkflow
 from .pixel_classification import (ImageFilterTask,
-                                   PixelClassificationWorkflow)
+                                   PixelClassificationWorkflow,
+                                   WriteCarving)
 from .multicut import MulticutWorkflow
 from .mutex_watershed import MwsWorkflow, TwoPassMwsWorkflow
 from .postprocess import (ConnectedComponentsWorkflow, FilterLabelsWorkflow,
@@ -39,6 +40,7 @@ __all__ = [
     "BigcatWorkflow", "BlocksFromMask", "CheckComponents", "CheckSubGraphs",
     "CopyVolumeTask", "DecompositionWorkflow", "DownscalingWorkflow",
     "ImageFilterTask", "InsertAffinities", "MeshWorkflow", "MinFilterMask",
+    "WriteCarving",
     "PainteraConversionWorkflow", "PixelClassificationWorkflow",
     "SmoothedGradients",
     "AgglomerateTask", "AgglomerativeClusteringWorkflow",

@@ -47,7 +47,11 @@ class BlockEdgeFeatures(BlockTask):
         from ..core.runtime import BlockTask
 
         conf = BlockTask.default_task_config()
-        conf.update({"e_max": 65536})
+        # filters + sigmas: optional filter-bank features (reference:
+        # block_edge_features.py:165-230 _accumulate_block) — each
+        # (filter, sigma) response contributes a 9-column stat group;
+        # the sample-count column is shared and written once at the end
+        conf.update({"e_max": 65536, "filters": None, "sigmas": None})
         return conf
 
     def __init__(self, input_path: str, input_key: str, labels_path: str,
@@ -107,6 +111,12 @@ class BlockEdgeFeatures(BlockTask):
             # to GLOBAL edge ids to keep seam faces (graph loaded once/job)
             _, global_edges, _ = g.load_graph(cfg["graph_path"],
                                               cfg.get("graph_key", "graph"))
+        responses = [(fn, s) for fn in (cfg.get("filters") or [])
+                     for s in (cfg.get("sigmas") or [])]
+        if responses and offsets is not None:
+            raise ValueError("filter-bank features are defined for boundary "
+                             "maps only (reference: _accumulate_block)")
+        n_feats = 9 * len(responses) + 1 if responses else 10
 
         for block_id in job_config["block_list"]:
             block = blocking.get_block(block_id)
@@ -129,10 +139,47 @@ class BlockEdgeFeatures(BlockTask):
             if len(edges) == 0 and offsets is None:
                 np.savez(_block_feature_path(cfg["output_path"], block_id),
                          edge_ids=np.zeros(0, "int64"),
-                         features=np.zeros((0, 10), "float64"))
+                         features=np.zeros((0, n_feats), "float64"))
                 log_fn(f"processed block {block_id}")
                 continue
-            if offsets is None:
+            if responses:
+                # filter-bank features: one device filter response per
+                # (filter, sigma), each accumulated with the same boundary
+                # sampling; support halo must cover the full kernel radius
+                # (truncate=4.0 in ops/filters._gaussian_kernel) so blockwise
+                # responses equal the global ones up to the volume border
+                from ..ops.filters import apply_filter
+
+                import jax
+
+                halo_v = int(4.0 * max(cfg["sigmas"]) + 0.5) + 1
+                obegin = [max(b - halo_v, 0) for b in begin]
+                oend = [min(e + halo_v, s)
+                        for e, s in zip(end, cfg["shape"])]
+                obb = tuple(slice(b, e) for b, e in zip(obegin, oend))
+                raw = jnp.asarray(ds_in[obb].astype("float32") / scale)
+                local = tuple(slice(b - ob, e - ob)
+                              for b, ob, e in zip(begin, obegin, end))
+                dense_dev = jnp.asarray(dense)
+                resp_stack = jnp.stack([apply_filter(raw, fn, s)[local]
+                                        for fn, s in responses])
+                # u/v/ok derive from the labels only, so under vmap they
+                # stay unbatched and the O(volume) pair extraction runs
+                # once; only the value gather is per-response
+                u, v, vals, ok = jax.vmap(
+                    lambda m: boundary_pair_values(
+                        dense_dev, m, inner_shape=tuple(block.shape)),
+                    out_axes=(None, None, 0, None))(resp_stack)
+                groups = []
+                for k in range(len(responses)):
+                    uv_dense, ef = device_edge_stats(
+                        u, v, vals[k], ok,
+                        e_max=int(cfg.get("e_max", 65536)))
+                    groups.append(ef)
+                edge_feats = np.concatenate(
+                    [f[:, :9] for f in groups] + [groups[-1][:, 9:10]],
+                    axis=1)
+            elif offsets is None:
                 bmap = ds_in[bb].astype("float32") / scale
                 u, v, val, ok = boundary_pair_values(
                     jnp.asarray(dense), jnp.asarray(bmap),
@@ -145,17 +192,19 @@ class BlockEdgeFeatures(BlockTask):
                     inner_begin=tuple(b - bo for b, bo in
                                       zip(block.begin, begin)),
                     inner_shape=tuple(block.shape))
-            # per-edge reduction ON DEVICE: only the compact (uv, stats)
-            # tables cross the host link (the padded sample arrays are ~10x
-            # the block size — transfer-bound on tunnel-attached chips)
-            uv_dense, edge_feats = device_edge_stats(
-                u, v, val, ok, e_max=int(cfg.get("e_max", 65536)))
+            if not responses:
+                # per-edge reduction ON DEVICE: only the compact (uv, stats)
+                # tables cross the host link (the padded sample arrays are
+                # ~10x the block size — transfer-bound on tunnel-attached
+                # chips).  The filter branch already reduced per response.
+                uv_dense, edge_feats = device_edge_stats(
+                    u, v, val, ok, e_max=int(cfg.get("e_max", 65536)))
             uv = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]], axis=1)
             if offsets is None:
                 # boundary faces share the RAG's ownership rule, so every
                 # edge maps into the block's own sub-graph
                 local_ids = g.find_edge_ids(edges, uv)
-                feats = np.zeros((len(edges), 10), "float64")
+                feats = np.zeros((len(edges), n_feats), "float64")
                 feats[local_ids] = edge_feats
                 out_ids = edge_ids
             else:
@@ -189,14 +238,25 @@ class MergeEdgeFeatures(BlockTask):
         _, edges, attrs = g.load_graph(self.graph_path, self.graph_key)
         n_edges = int(attrs["n_edges"])
         chunk = max(1, (n_edges + self.max_jobs - 1) // self.max_jobs)
+        # feature width comes from the already-written block files (10 for
+        # plain maps, 9*n_responses+1 for filter-bank features)
+        n_feats = 10
+        feat_dir = os.path.join(self.output_path, _BLOCK_FEAT_DIR)
+        if os.path.isdir(feat_dir):
+            for name in sorted(os.listdir(feat_dir)):
+                if name.startswith("block_") and name.endswith(".npz"):
+                    with np.load(os.path.join(feat_dir, name)) as d:
+                        n_feats = int(d["features"].shape[1])
+                    break
         with file_reader(self.output_path) as f:
-            f.require_dataset(self.output_key, shape=(n_edges, 10),
-                              chunks=(min(n_edges, 64 * 1024), 10),
+            f.require_dataset(self.output_key, shape=(n_edges, n_feats),
+                              chunks=(min(n_edges, 64 * 1024), n_feats),
                               dtype="float64")
         chunks = list(range(0, n_edges, chunk))
         self.run_jobs(chunks, {
             "graph_path": self.graph_path, "output_path": self.output_path,
             "output_key": self.output_key, "n_edges": n_edges, "chunk": chunk,
+            "n_feats": n_feats,
         }, n_jobs=self.max_jobs, consecutive_blocks=True)
 
     @classmethod
@@ -205,6 +265,7 @@ class MergeEdgeFeatures(BlockTask):
 
         cfg = job_config["config"]
         n_edges, chunk = cfg["n_edges"], cfg["chunk"]
+        n_feats = int(cfg.get("n_feats", 10))
         feat_dir = os.path.join(cfg["output_path"], _BLOCK_FEAT_DIR)
         block_files = [os.path.join(feat_dir, n) for n in os.listdir(feat_dir)
                        if n.startswith("block_") and n.endswith(".npz")]
@@ -225,8 +286,8 @@ class MergeEdgeFeatures(BlockTask):
                 if sel.any():
                     partials[e0].append((ids[sel] - e0, feats[sel]))
         for e0, e1 in ranges:
-            merged = merge_feature_blocks(partials[e0], e1 - e0)
-            ds[slice(e0, e1), slice(0, 10)] = merged
+            merged = merge_feature_blocks(partials[e0], e1 - e0, n_feats)
+            ds[slice(e0, e1), slice(0, n_feats)] = merged
             log_fn(f"processed block {e0}")
 
 

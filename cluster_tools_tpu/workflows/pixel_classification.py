@@ -304,3 +304,129 @@ class PixelClassificationWorkflow(Task):
     def output(self):
         return FileTarget(os.path.join(self.tmp_folder,
                                        "predict_pixel_classifier.status"))
+
+
+class WriteCarving(Task):
+    """Export graph + edge weights as an ilastik carving project (.ilp h5)
+    (reference: ilastik/carving.py:10-123 ``WriteCarving``).
+
+    The graph dataset follows the serialization the reference targets
+    (vigra adjacencyListGraph): a flat uint32 array
+    ``[n_nodes, n_edges, max_node_id, max_edge_id] + uv_ids.ravel() +
+    neighborhoods``, where ``neighborhoods`` lists, per node id in order,
+    its degree followed by (neighbor_id, edge_id) pairs sorted by neighbor.
+    Edge weights are the mean-probability feature column rescaled to the
+    carving convention's 0-255 range (reference: carving.py:57-69)."""
+
+    def __init__(self, graph_path: str, graph_key: str, features_path: str,
+                 features_key: str, output_path: str, raw_path: str,
+                 raw_key: str, uid: str, tmp_folder: str,
+                 copy_inputs: bool = False,
+                 dependency: Optional[Task] = None):
+        self.graph_path = graph_path
+        self.graph_key = graph_key
+        self.features_path = features_path
+        self.features_key = features_key
+        self.output_path = output_path
+        self.raw_path = raw_path
+        self.raw_key = raw_key
+        self.uid = uid
+        self.copy_inputs = copy_inputs
+        self.tmp_folder = tmp_folder
+        self.dependency = dependency
+        super().__init__()
+
+    def requires(self):
+        return self.dependency
+
+    @staticmethod
+    def serialize_graph(uv_ids: np.ndarray,
+                        max_node_id: int) -> np.ndarray:
+        """Flat uint32 serialization (header + uv ids + neighborhoods)."""
+        n_edges = len(uv_ids)
+        header = np.array([max_node_id + 1, n_edges,
+                           max_node_id, max(n_edges - 1, 0)], "uint32")
+        # per-node adjacency: degree, then (neighbor, edge_id) by neighbor
+        adj = [[] for _ in range(max_node_id + 1)]
+        for eid, (u, v) in enumerate(uv_ids):
+            adj[u].append((v, eid))
+            adj[v].append((u, eid))
+        hoods = []
+        for node_adj in adj:
+            hoods.append(len(node_adj))
+            for nb, eid in sorted(node_adj):
+                hoods.extend((nb, eid))
+        return np.concatenate([header, uv_ids.astype("uint32").ravel(),
+                               np.asarray(hoods, "uint32")])
+
+    def run(self):
+        import time
+
+        import h5py
+
+        from ..core.graph import load_graph
+
+        _, edges, attrs = load_graph(self.graph_path, self.graph_key)
+        if len(edges) and int(edges.max()) >= 2 ** 32:
+            raise ValueError(
+                f"carving serialization is uint32; node ids reach "
+                f"{int(edges.max())} — relabel to consecutive ids first")
+        uv_ids = edges.astype("uint32")
+        max_node_id = int(uv_ids.max()) if len(uv_ids) else 0
+        serialization = self.serialize_graph(uv_ids, max_node_id)
+
+        with file_reader(self.features_path, "r") as f:
+            feats = np.asarray(f[self.features_key][:, 0]).squeeze()
+        feats = feats * 255.0  # carving weights use the 0-255 range
+
+        # mode 'w' truncates: a retry after a partial previous run must not
+        # trip over half-written groups (the export is single-writer)
+        with h5py.File(self.output_path, "w") as f:
+            g = f.create_group("preprocessing/graph")
+            g.create_dataset("graph", data=serialization,
+                             compression="gzip")
+            g.create_dataset("nodeSeeds", shape=(max_node_id + 1,),
+                             dtype="uint8")
+            g.create_dataset("resultSegmentation", shape=(max_node_id + 1,),
+                             dtype="uint8")
+            g.attrs["numNodes"] = max_node_id + 1
+            g.create_dataset("edgeWeights", data=feats)
+
+            gi = f.create_group("Input Data")
+            gi.create_dataset("Role Names",
+                              data=[b"Raw Data", b"Overlay"])
+            gi.create_dataset("StorageVersion", data="0.2")
+            gi.create_group("local_data")
+            lane = f.create_group("Input Data/infos/lane0000/Raw Data")
+            lane.create_dataset("allowLabels", data=True)
+            lane.create_dataset("axisorder", data=b"zyx")
+            lane.create_dataset("fromstack", data=False)
+            lane.create_dataset("datasetId", data=self.uid.encode("utf-8"))
+            lane.create_dataset("display_mode", data=b"default")
+            lane.create_dataset(
+                "filePath",
+                data=os.path.join(self.raw_path,
+                                  self.raw_key).encode("utf-8"))
+            lane.create_dataset(
+                "location", data=b"ProjectInternal" if self.copy_inputs
+                else b"FileSystem")
+            lane.create_dataset("nickname", data=b"Input")
+
+            f.create_dataset("workflowName", data=b"Carving")
+            f.create_dataset("ilastikVersion", data=b"1.3.0b2")
+            f.create_dataset("currentApplet", data=2)
+            f.create_dataset("time", data=time.ctime().encode("utf-8"))
+            f.create_dataset("preprocessing/StorageVersion", data="0.1")
+            f.create_dataset("preprocessing/filter", data=3)
+            f.create_dataset("preprocessing/sigma", data=1.0)
+            f.create_dataset("preprocessing/invert_watershed_source",
+                             data=False)
+            f.create_dataset("preprocessing/watershed_source",
+                             data=b"filtered")
+            f.create_dataset("carving/StorageVersion", data="0.1")
+            f.create_group("carving/objects")
+        self.output().touch()
+
+    def output(self):
+        return FileTarget(os.path.join(self.tmp_folder,
+                                       "write_carving.status"))

@@ -243,3 +243,65 @@ def test_densify_labels_roundtrip():
     lut, dense = densify_labels(np.array([7, 9], dtype="uint64"))
     assert lut[0] == 0 and (dense > 0).all()
     np.testing.assert_array_equal(lut[dense], [7, 9])
+
+
+def test_filter_bank_edge_features(graph_setup, tmp_path):
+    """Filter-bank features (reference: block_edge_features.py:165-230):
+    each (filter, sigma) response contributes a 9-column stat group + one
+    shared count column.  Oracle: group k of the filtered run must equal the
+    plain-feature run on the precomputed filter response (halo covers the
+    kernel support, so blockwise filtering is exact)."""
+    import jax.numpy as jnp
+
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.ops.filters import apply_filter
+    from cluster_tools_tpu.workflows.features import EdgeFeaturesWorkflow
+    from cluster_tools_tpu.workflows.graph import GraphWorkflow
+
+    labels, path, tmp_folder, config_dir = graph_setup
+    rng = np.random.RandomState(2)
+    bmap = rng.rand(*labels.shape).astype("float32")
+    _write_volume(path, "boundaries", bmap, (10, 10, 10))
+    # precomputed responses as their own input datasets (plain-path oracle)
+    responses = [("gaussianSmoothing", 1.0), ("laplacianOfGaussian", 1.0)]
+    for fn, s in responses:
+        resp = np.asarray(apply_filter(jnp.asarray(bmap), fn, s))
+        _write_volume(path, f"resp_{fn}", resp.astype("float32"),
+                      (10, 10, 10))
+    graph_path = str(tmp_path / "graph.n5")
+
+    wf = GraphWorkflow(input_path=path, input_key="labels",
+                       graph_path=graph_path, tmp_folder=tmp_folder,
+                       config_dir=config_dir, max_jobs=2, target="threads")
+    ConfigDir(config_dir).write_task_config(
+        "block_edge_features",
+        {"filters": [fn for fn, _ in responses], "sigmas": [1.0]})
+    fw = EdgeFeaturesWorkflow(
+        input_path=path, input_key="boundaries", labels_path=path,
+        labels_key="labels", graph_path=graph_path,
+        output_path=str(tmp_path / "filtered.n5"),
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=2,
+        target="threads", dependency=wf)
+    assert ctt.build([fw])
+    with file_reader(str(tmp_path / "filtered.n5"), "r") as f:
+        filtered = f["features"][:]
+    assert filtered.shape[1] == 9 * len(responses) + 1
+
+    # plain features on each precomputed response, in clean workdirs
+    ConfigDir(config_dir).write_task_config("block_edge_features", {})
+    for k, (fn, _) in enumerate(responses):
+        sub_tmp = os.path.join(tmp_folder, f"plain_{fn}")
+        fw_k = EdgeFeaturesWorkflow(
+            input_path=path, input_key=f"resp_{fn}", labels_path=path,
+            labels_key="labels", graph_path=graph_path,
+            output_path=str(tmp_path / f"plain_{fn}.n5"),
+            tmp_folder=sub_tmp, config_dir=config_dir, max_jobs=2,
+            target="threads")
+        assert ctt.build([fw_k])
+        with file_reader(str(tmp_path / f"plain_{fn}.n5"), "r") as f:
+            plain = f["features"][:]
+        np.testing.assert_allclose(filtered[:, 9 * k:9 * k + 9],
+                                   plain[:, :9], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(filtered[:, -1], plain[:, 9])

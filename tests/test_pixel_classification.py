@@ -154,3 +154,54 @@ def test_sub_solutions_debug_task(tmp_workdir, tmp_path):
         painted1 = f["sub_solutions_s1"][:]
     assert (painted1 > 0).all()
     assert len(np.unique(painted1)) <= len(np.unique(painted))
+
+
+def test_write_carving(tmp_workdir, tmp_path):
+    """Carving .ilp export (reference: ilastik/carving.py): graph
+    serialization round-trips (header/uv/neighborhoods consistent), edge
+    weights are the 0-255-scaled mean column, metadata groups present."""
+    import h5py
+
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.graph import save_graph
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.workflows.pixel_classification import WriteCarving
+
+    tmp_folder, config_dir = tmp_workdir
+    graph_path = str(tmp_path / "graph.n5")
+    edges = np.array([[0, 1], [1, 2], [0, 2], [2, 3]], "uint64")
+    save_graph(graph_path, "graph", np.arange(4, dtype="uint64"), edges,
+               {"n_nodes": 4, "n_edges": 4})
+    feat_path = str(tmp_path / "feats.n5")
+    feats = np.zeros((4, 10), "float64")
+    feats[:, 0] = [0.1, 0.5, 0.9, 1.0]
+    with file_reader(feat_path) as f:
+        f.create_dataset("features", data=feats, chunks=[4, 10])
+
+    out = str(tmp_path / "carving.ilp")
+    task = WriteCarving(
+        graph_path=graph_path, graph_key="graph",
+        features_path=feat_path, features_key="features",
+        output_path=out, raw_path=str(tmp_path / "raw.n5"), raw_key="raw",
+        uid="test-uid", tmp_folder=tmp_folder)
+    assert ctt.build([task])
+
+    with h5py.File(out, "r") as f:
+        ser = f["preprocessing/graph/graph"][:]
+        weights = f["preprocessing/graph/edgeWeights"][:]
+        seeds = f["preprocessing/graph/nodeSeeds"][:]
+        assert f["preprocessing/graph"].attrs["numNodes"] == 4
+        assert f["workflowName"][()] == b"Carving"
+        assert "carving/objects" in f
+        assert f["Input Data/infos/lane0000/Raw Data/datasetId"][()] \
+            == b"test-uid"
+    np.testing.assert_allclose(weights, feats[:, 0] * 255.0)
+    assert seeds.shape == (4,) and (seeds == 0).all()
+    # header + uv block + neighborhoods
+    assert list(ser[:4]) == [4, 4, 3, 3]
+    np.testing.assert_array_equal(ser[4:12].reshape(4, 2), edges)
+    hoods = ser[12:]
+    # node 0: degree 2, neighbors (1,e0), (2,e2)
+    assert hoods[0] == 2 and list(hoods[1:5]) == [1, 0, 2, 2]
+    # total length: per node 1 + 2*degree; sum(degree) = 2*n_edges
+    assert len(hoods) == 4 + 2 * 2 * len(edges)

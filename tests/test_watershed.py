@@ -261,3 +261,40 @@ def test_watershed_fragment_purity():
         best[w] = max(best.get(w, 0), int(c))
     purity = np.array([best[w] / tot[w] for w in tot])
     assert purity.min() > 0.97, purity
+
+
+def test_suppress_maxima():
+    """Distance-based NMS (reference: nonMaximumDistanceSuppression path,
+    watershed.py:199-203): weaker maxima inside a stronger maximum's
+    dt-radius are dropped; points outside survive."""
+    from cluster_tools_tpu.workflows.watershed import suppress_maxima
+
+    pts = np.array([[0, 0, 0], [0, 0, 3], [0, 0, 8]], "int64")
+    radii = np.array([5.0, 1.0, 2.0])
+    kept = suppress_maxima(pts, radii)
+    # strongest kept; [0,0,3] is within radius 5 of it; [0,0,8] is outside
+    assert {tuple(p) for p in kept} == {(0, 0, 0), (0, 0, 8)}
+    # empty input passes through
+    assert len(suppress_maxima(np.zeros((0, 3), "int64"),
+                               np.zeros(0))) == 0
+
+
+def test_watershed_nms_reduces_fragments(tmp_workdir, tmp_path):
+    """non_maximum_suppression merges duplicate seeds on broad plateaus ->
+    fewer fragments, still a complete (no zeros) labeling."""
+    from cluster_tools_tpu.workflows.watershed import run_ws_block
+
+    rng = np.random.RandomState(0)
+    # one wide cell interior with a noisy DT -> several spurious maxima
+    bmap = np.ones((24, 24, 24), "float32")
+    bmap[2:22, 2:22, 2:22] = 0.05
+    bmap += rng.rand(24, 24, 24).astype("float32") * 0.04
+    cfg = {"threshold": 0.3, "sigma_seeds": 0.0, "size_filter": 0,
+           "apply_ws_2d": False}
+    ws_plain = run_ws_block(bmap, cfg)
+    ws_nms = run_ws_block(bmap, {**cfg, "non_maximum_suppression": True})
+    assert (ws_nms > 0).all()
+    n_plain = len(np.unique(ws_plain))
+    n_nms = len(np.unique(ws_nms))
+    assert n_nms <= n_plain
+    assert n_nms >= 1
