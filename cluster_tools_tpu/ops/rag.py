@@ -275,6 +275,16 @@ def device_edge_stats(u, v, values, ok, e_max: int = 65536):
     Inputs are padded to the next power of two so every (clipped) border
     block shares one compiled program — per-shape compiles of the sort
     kernel cost ~a minute each on tunnel-attached devices."""
+    return device_edge_stats_finalize(
+        device_edge_stats_submit(u, v, values, ok, e_max=e_max), e_max)
+
+
+def device_edge_stats_submit(u, v, values, ok, e_max: int = 65536):
+    """Enqueue the edge-stats device program WITHOUT synchronizing: returns
+    the device result handles so callers can pipeline several blocks (jax
+    async dispatch overlaps block i+1's compute with block i's readback —
+    per-block device latency dominates on tunnel-attached chips).  Pass the
+    handles to :func:`device_edge_stats_finalize`."""
     n = int(u.shape[0])
     n_pad = 1 << max(int(np.ceil(np.log2(max(n, 1)))), 4)
     if n_pad != n:
@@ -283,8 +293,13 @@ def device_edge_stats(u, v, values, ok, e_max: int = 65536):
         v = jnp.pad(v, (0, pad))
         values = jnp.pad(values, (0, pad))
         ok = jnp.pad(ok, (0, pad), constant_values=False)
-    uv, feats, n_runs, overflow = _edge_stats_device(u, v, values, ok,
-                                                     e_max=e_max)
+    return _edge_stats_device(u, v, values, ok, e_max=e_max)
+
+
+def device_edge_stats_finalize(handles, e_max: int = 65536):
+    """Synchronize one submitted edge-stats program and return the compact
+    host (uv, features) tables."""
+    uv, feats, n_runs, overflow = handles
     if int(overflow) > 0:
         raise RuntimeError(
             f"block has more than e_max={e_max} distinct edges; "
@@ -296,7 +311,12 @@ def device_edge_stats(u, v, values, ok, e_max: int = 65536):
 
 def device_unique_edges(u, v, ok, e_max: int = 65536) -> np.ndarray:
     """Compact unique (u, v) edge list computed on device (the RAG
-    extraction reduction; same sort machinery, no values)."""
+    extraction reduction; same sort machinery, no values).
+
+    Synchronous convenience API: blocks on the device result.  Pipelined
+    callers should use :func:`device_edge_stats_submit` /
+    :func:`device_edge_stats_finalize` instead (as InitialSubGraphs does)
+    so consecutive blocks overlap."""
     uv, _ = device_edge_stats(u, v, jnp.zeros_like(u, jnp.float32), ok,
                                e_max=e_max)
     return uv

@@ -88,7 +88,8 @@ class BlockEdgeFeatures(BlockTask):
         import jax.numpy as jnp
 
         from ..ops.rag import (affinity_pair_values, boundary_pair_values,
-                               densify_labels, device_edge_stats)
+                               densify_labels, device_edge_stats_finalize,
+                               device_edge_stats_submit)
 
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
@@ -118,16 +119,23 @@ class BlockEdgeFeatures(BlockTask):
                              "maps only (reference: _accumulate_block)")
         n_feats = 9 * len(responses) + 1 if responses else 10
 
-        for block_id in job_config["block_list"]:
+        e_max = int(cfg.get("e_max", 65536))
+
+        # two-stage pipeline: submit enqueues the device programs without
+        # synchronizing, drain materializes and writes — block i+1's
+        # transfers/compute overlap block i's readback + IO (per-block
+        # device latency dominates on tunnel-attached chips)
+        def submit(block_id: int):
             block = blocking.get_block(block_id)
             if offsets is None:
                 begin = list(block.begin)
                 end = [min(e + 1, s) for e, s in zip(block.end, cfg["shape"])]
             else:
-                # two-sided halo covering the longest offset (negative offsets
-                # reach backwards from anchors in the inner block)
+                # two-sided halo covering the longest offset (negative
+                # offsets reach backwards from anchors in the inner block)
                 reach = np.abs(np.asarray(offsets)).max(axis=0)
-                begin = [max(b - int(r), 0) for b, r in zip(block.begin, reach)]
+                begin = [max(b - int(r), 0)
+                         for b, r in zip(block.begin, reach)]
                 end = [min(e + int(r), s)
                        for e, r, s in zip(block.end, reach, cfg["shape"])]
             bb = tuple(slice(b, e) for b, e in zip(begin, end))
@@ -137,17 +145,14 @@ class BlockEdgeFeatures(BlockTask):
             # affinity mode must proceed even with an empty local sub-graph:
             # this block may still own anchor samples of seam edges
             if len(edges) == 0 and offsets is None:
-                np.savez(_block_feature_path(cfg["output_path"], block_id),
-                         edge_ids=np.zeros(0, "int64"),
-                         features=np.zeros((0, n_feats), "float64"))
-                log_fn(f"processed block {block_id}")
-                continue
+                return block_id, None, None, None, None
             if responses:
                 # filter-bank features: one device filter response per
                 # (filter, sigma), each accumulated with the same boundary
                 # sampling; support halo must cover the full kernel radius
-                # (truncate=4.0 in ops/filters._gaussian_kernel) so blockwise
-                # responses equal the global ones up to the volume border
+                # (truncate=4.0 in ops/filters._gaussian_kernel) so
+                # blockwise responses equal the global ones up to the
+                # volume border
                 from ..ops.filters import apply_filter
 
                 import jax
@@ -170,36 +175,51 @@ class BlockEdgeFeatures(BlockTask):
                     lambda m: boundary_pair_values(
                         dense_dev, m, inner_shape=tuple(block.shape)),
                     out_axes=(None, None, 0, None))(resp_stack)
-                groups = []
-                for k in range(len(responses)):
-                    uv_dense, ef = device_edge_stats(
-                        u, v, vals[k], ok,
-                        e_max=int(cfg.get("e_max", 65536)))
-                    groups.append(ef)
-                edge_feats = np.concatenate(
-                    [f[:, :9] for f in groups] + [groups[-1][:, 9:10]],
-                    axis=1)
+                handles = [device_edge_stats_submit(u, v, vals[k], ok,
+                                                    e_max=e_max)
+                           for k in range(len(responses))]
             elif offsets is None:
                 bmap = ds_in[bb].astype("float32") / scale
                 u, v, val, ok = boundary_pair_values(
                     jnp.asarray(dense), jnp.asarray(bmap),
                     inner_shape=tuple(block.shape))
+                # per-edge reduction ON DEVICE: only the compact (uv,
+                # stats) tables cross the host link (the padded sample
+                # arrays are ~10x the block size)
+                handles = [device_edge_stats_submit(u, v, val, ok,
+                                                    e_max=e_max)]
             else:
-                affs = ds_in[(slice(0, len(offsets)),) + bb].astype("float32")
-                affs /= scale
+                affs = ds_in[(slice(0, len(offsets)),) + bb]
+                affs = affs.astype("float32") / scale
                 u, v, val, ok = affinity_pair_values(
                     jnp.asarray(dense), jnp.asarray(affs), offsets,
                     inner_begin=tuple(b - bo for b, bo in
                                       zip(block.begin, begin)),
                     inner_shape=tuple(block.shape))
-            if not responses:
-                # per-edge reduction ON DEVICE: only the compact (uv, stats)
-                # tables cross the host link (the padded sample arrays are
-                # ~10x the block size — transfer-bound on tunnel-attached
-                # chips).  The filter branch already reduced per response.
-                uv_dense, edge_feats = device_edge_stats(
-                    u, v, val, ok, e_max=int(cfg.get("e_max", 65536)))
-            uv = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]], axis=1)
+                handles = [device_edge_stats_submit(u, v, val, ok,
+                                                    e_max=e_max)]
+            return block_id, lut, edges, edge_ids, handles
+
+        def drain(entry):
+            block_id, lut, edges, edge_ids, handles = entry
+            if handles is None:
+                np.savez(_block_feature_path(cfg["output_path"], block_id),
+                         edge_ids=np.zeros(0, "int64"),
+                         features=np.zeros((0, n_feats), "float64"))
+                log_fn(f"processed block {block_id}")
+                return
+            groups = []
+            for h in handles:
+                uv_dense, ef = device_edge_stats_finalize(h, e_max)
+                groups.append(ef)
+            if responses:
+                edge_feats = np.concatenate(
+                    [f[:, :9] for f in groups] + [groups[-1][:, 9:10]],
+                    axis=1)
+            else:
+                edge_feats = groups[0]
+            uv = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]],
+                          axis=1)
             if offsets is None:
                 # boundary faces share the RAG's ownership rule, so every
                 # edge maps into the block's own sub-graph
@@ -216,6 +236,17 @@ class BlockEdgeFeatures(BlockTask):
             np.savez(_block_feature_path(cfg["output_path"], block_id),
                      edge_ids=out_ids.astype("int64"), features=feats)
             log_fn(f"processed block {block_id}")
+
+        from collections import deque
+
+        window = int(cfg.get("stream_window", 3))
+        pending = deque()
+        for block_id in job_config["block_list"]:
+            pending.append(submit(block_id))
+            if len(pending) > window:
+                drain(pending.popleft())
+        while pending:
+            drain(pending.popleft())
 
 
 class MergeEdgeFeatures(BlockTask):

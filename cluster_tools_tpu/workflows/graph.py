@@ -57,37 +57,61 @@ class InitialSubGraphs(BlockTask):
 
     @classmethod
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from collections import deque
+
         import jax.numpy as jnp
 
-        from ..ops.rag import densify_labels, device_unique_edges, label_pairs
+        from ..ops.rag import (densify_labels, device_edge_stats_finalize,
+                               device_edge_stats_submit, label_pairs)
 
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
         ignore_label = bool(cfg.get("ignore_label", True))
+        e_max = int(cfg.get("e_max", 65536))
         f = file_reader(cfg["input_path"], "r")
         ds = f[cfg["input_key"]]
-        for block_id in job_config["block_list"]:
+
+        # two-stage pipeline over the job's blocks: submit enqueues the
+        # device programs without synchronizing, drain materializes —
+        # block i+1's transfer/compute overlap block i's readback + IO
+        def submit(block_id: int):
             block = blocking.get_block(block_id)
             # +1 halo on upper faces only, clipped at the volume border
             end = [min(e + 1, s) for e, s in zip(block.end, cfg["shape"])]
             bb = tuple(slice(b, e) for b, e in zip(block.begin, end))
             labels = ds[bb]
             lut, dense = densify_labels(labels)
+            # nodes straight from the densification LUT (sorted uniques
+            # with 0 prepended) — no second full-block unique, and the
+            # pending window holds only the small per-block tables
+            zero_present = bool(dense.min() == 0) if dense.size else False
+            nodes = lut if (zero_present and not ignore_label) else lut[1:]
             u, v, ok = label_pairs(jnp.asarray(dense),
                                    ignore_label=ignore_label,
                                    inner_shape=tuple(block.shape))
             # edge dedup ON DEVICE: only the compact edge table crosses the
             # host link (the padded pair arrays are ~6x the block size)
-            uv_dense = device_unique_edges(
-                u, v, ok, e_max=int(cfg.get("e_max", 65536)))
+            handles = device_edge_stats_submit(
+                u, v, jnp.zeros_like(u, jnp.float32), ok, e_max=e_max)
+            return block_id, nodes, lut, handles
+
+        def drain(entry):
+            block_id, nodes, lut, handles = entry
+            uv_dense, _ = device_edge_stats_finalize(handles, e_max)
             edges = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]],
                              axis=1).astype("uint64")
-            nodes = np.unique(labels)
-            if ignore_label:
-                nodes = nodes[nodes != 0]
             g.save_sub_graph(cfg["graph_path"], 0, block_id,
                              nodes.astype("uint64"), edges)
             log_fn(f"processed block {block_id}")
+
+        window = int(cfg.get("stream_window", 3))
+        pending = deque()
+        for block_id in job_config["block_list"]:
+            pending.append(submit(block_id))
+            if len(pending) > window:
+                drain(pending.popleft())
+        while pending:
+            drain(pending.popleft())
 
 
 class MergeSubGraphs(BlockTask):

@@ -376,7 +376,7 @@ class WriteCarving(Task):
         serialization = self.serialize_graph(uv_ids, max_node_id)
 
         with file_reader(self.features_path, "r") as f:
-            feats = np.asarray(f[self.features_key][:, 0]).squeeze()
+            feats = np.asarray(f[self.features_key][:, 0])
         feats = feats * 255.0  # carving weights use the 0-255 range
 
         # mode 'w' truncates: a retry after a partial previous run must not

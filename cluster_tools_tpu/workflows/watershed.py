@@ -194,65 +194,59 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
     return ws.astype("uint64")
 
 
-def run_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
+def iter_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
     """Process a stream of 3d blocks through ONE fused jitted watershed
-    pipeline with async dispatch: block i+1's host->device transfer and
-    compute overlap block i's device->host readback (jax's async dispatch
-    queues everything; only the final np conversions synchronize).  This is
-    the deployment pattern of the blockwise tasks (the inference task's
-    IO/compute overlap, SURVEY §3.4) — per-block latency is hidden, the
-    metric is stream throughput.
+    pipeline with async dispatch, yielding results in input order: block
+    i+1's host->device transfer and compute overlap block i's device->host
+    readback (jax's async dispatch queues everything; only the final np
+    conversions synchronize).  This is the deployment pattern of the
+    blockwise tasks (the inference task's IO/compute overlap, SURVEY §3.4)
+    — per-block latency is hidden, the metric is stream throughput.
 
-    3d path only: 2d modes, masks and pixel_pitch need run_ws_block."""
+    3d path only: 2d modes, masks, NMS and pixel_pitch need run_ws_block."""
     import jax.numpy as jnp
 
-    from ..ops.watershed import size_filter
-
-    unsupported = [k for k in ("apply_dt_2d", "apply_ws_2d", "pixel_pitch")
-                   if cfg.get(k)]
+    unsupported = [k for k in ("apply_dt_2d", "apply_ws_2d", "pixel_pitch",
+                               "non_maximum_suppression") if cfg.get(k)]
     if unsupported:
         raise ValueError(
-            f"run_ws_blocks_stream supports the plain 3d pipeline only; "
+            f"iter_ws_blocks_stream supports the plain 3d pipeline only; "
             f"{unsupported} need run_ws_block")
     pipeline = _ws_pipeline_3d(
         float(cfg.get("threshold", 0.25)),
         float(cfg.get("sigma_seeds", 2.0)),
         float(cfg.get("sigma_weights", 2.0)),
-        float(cfg.get("alpha", 0.8)))
-    min_size = cfg.get("size_filter", 25)
+        float(cfg.get("alpha", 0.8)),
+        int(cfg.get("size_filter", 25) or 0))
     # bounded look-ahead: dispatch a few blocks ahead, drain as results are
     # consumed — unbounded queueing would hold every output buffer in HBM
     # (~150 MB per reference-size block)
     window = int(cfg.get("stream_window", 3))
     from collections import deque
 
-    results = []
     pending: "deque" = deque()
-
-    def _drain():
-        ws_dev, height_dev = pending.popleft()
-        ws = np.asarray(ws_dev)
-        if min_size:
-            # height is only transferred when the filter needs it for the
-            # regrow (same flooding surface as run_ws_block)
-            ws = size_filter(ws, np.asarray(height_dev), min_size)
-        results.append(ws.astype("uint64"))
-
     for b in blocks:
         pending.append(pipeline(jnp.asarray(b)))  # queued async
         if len(pending) > window:
-            _drain()
+            yield np.asarray(pending.popleft()).astype("uint64")
     while pending:
-        _drain()
-    return results
+        yield np.asarray(pending.popleft()).astype("uint64")
+
+
+def run_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
+    """List-returning wrapper over :func:`iter_ws_blocks_stream`."""
+    return list(iter_ws_blocks_stream(blocks, cfg))
 
 
 @lru_cache(maxsize=8)
 def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
-                    sigma_weights: float, alpha: float):
+                    sigma_weights: float, alpha: float, min_size: int = 0):
     """Cached fused jitted pipeline — one compile per parameter set (the
     jit cache lives on the returned function, so re-creating the closure per
-    call would recompile every time)."""
+    call would recompile every time).  With ``min_size`` the size filter is
+    fused in: per-label device bincount + one regrow pass over the same
+    height map — no height/label round-trip to the host (the transfers
+    dominated the streamed task on tunnel-attached chips)."""
     import jax
     import jax.numpy as jnp
 
@@ -272,7 +266,17 @@ def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
         maxima = local_maxima(dt_smooth, radius=2) & fg
         seeds = connected_components(maxima, connectivity=3,
                                      method="propagation")
-        return seeded_watershed(height, seeds, None, connectivity=1), height
+        ws = seeded_watershed(height, seeds, None, connectivity=1)
+        if min_size:
+            # label ids are bounded by the voxel count (CC roots + 1), so a
+            # fixed-length bincount stays shape-static under jit
+            counts = jnp.bincount(ws.ravel().astype(jnp.int32),
+                                  length=int(np.prod(x.shape)) + 1)
+            small = counts < min_size
+            small = small.at[0].set(False)
+            kept = jnp.where(small[ws], 0, ws)
+            ws = seeded_watershed(height, kept, None, connectivity=1)
+        return ws
 
     return pipeline
 
@@ -449,6 +453,45 @@ class WatershedTask(BlockTask):
 
         outer_shape = tuple(b + 2 * h
                             for b, h in zip(cfg["block_shape"], halo))
+
+        def _write_result(block_id: int, ws: np.ndarray) -> None:
+            block = blocking.get_block(block_id)
+            inner_sl = tuple(slice(h, h + (b.stop - b.start))
+                             for h, b in zip(halo, block.bb))
+            inner = ws[inner_sl]
+            # compact to 1..k (k <= inner voxel count < offset unit), THEN
+            # offset for global uniqueness (reference: watershed.py:307) —
+            # uncompacted CC root indices range over the larger outer block
+            # and would collide across blocks
+            nonzero = np.unique(inner[inner > 0])
+            compact = np.searchsorted(nonzero, inner).astype("uint64") + 1
+            compact[inner == 0] = 0
+            compact = np.where(
+                compact > 0,
+                compact + np.uint64(block_id) * label_offset_unit, 0)
+            ds_out[block.bb] = compact
+            log_fn(f"processed block {block_id}")
+
+        # plain 3d path: stream every block of the job through one fused
+        # jitted pipeline with async dispatch — transfers and compute of
+        # consecutive blocks overlap, hiding per-block device latency
+        # (dominant on tunnel-attached chips; profiled 32s -> the single
+        # largest task span of BASELINE config 4)
+        streamable = (not seeded and mask is None
+                      and not cfg.get("apply_dt_2d")
+                      and not cfg.get("apply_ws_2d")
+                      and not cfg.get("pixel_pitch")
+                      and not cfg.get("non_maximum_suppression"))
+        if streamable:
+            block_ids = list(job_config["block_list"])
+            reads = (_read_padded_input(ds_in, blocking.get_block(bid),
+                                        cfg, halo)
+                     for bid in block_ids)
+            for bid, ws in zip(block_ids,
+                               iter_ws_blocks_stream(reads, cfg)):
+                _write_result(bid, ws)
+            return
+
         for block_id in job_config["block_list"]:
             block = blocking.get_block(block_id)
             bh = blocking.get_block_with_halo(block_id, halo)
@@ -493,18 +536,7 @@ class WatershedTask(BlockTask):
                 log_fn(f"processed block {block_id}")
                 continue
             ws = run_ws_block(data, cfg, bmask)
-            inner = ws[inner_sl]
-            # compact to 1..k (k <= inner voxel count < offset unit), THEN
-            # offset for global uniqueness (reference: watershed.py:307) —
-            # uncompacted CC root indices range over the larger outer block
-            # and would collide across blocks
-            nonzero = np.unique(inner[inner > 0])
-            compact = np.searchsorted(nonzero, inner).astype("uint64") + 1
-            compact[inner == 0] = 0
-            compact = np.where(
-                compact > 0, compact + np.uint64(block_id) * label_offset_unit, 0)
-            ds_out[block.bb] = compact
-            log_fn(f"processed block {block_id}")
+            _write_result(block_id, ws)
 
 
 class WatershedPass1Task(WatershedTask):

@@ -298,3 +298,17 @@ def test_watershed_nms_reduces_fragments(tmp_workdir, tmp_path):
     n_nms = len(np.unique(ws_nms))
     assert n_nms <= n_plain
     assert n_nms >= 1
+
+
+def test_streamed_pipeline_matches_blockwise_with_size_filter():
+    """The fused on-device size filter (bincount + regrow inside the jitted
+    pipeline) matches run_ws_block's host size_filter path."""
+    from cluster_tools_tpu.workflows.watershed import (run_ws_block,
+                                                       run_ws_blocks_stream)
+
+    vol = _boundary_volume((16, 24, 24), n_cells=6)
+    cfg = {"threshold": 0.5, "sigma_seeds": 2.0, "sigma_weights": 2.0,
+           "alpha": 0.8, "size_filter": 40}
+    single = run_ws_block(vol, cfg)
+    streamed = run_ws_blocks_stream([vol], cfg)[0]
+    np.testing.assert_array_equal(streamed, single)
