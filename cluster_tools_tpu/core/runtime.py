@@ -107,6 +107,26 @@ def parse_job_runtime(log_path: str) -> Optional[float]:
     return (last - first).total_seconds()
 
 
+def stream_window(items, submit, drain, window: int = 3):
+    """Bounded submit/drain pipeline over ``items``: keep up to ``window``
+    submitted entries in flight before draining the oldest, yielding each
+    drained result in input order.  The standard shape for blockwise device
+    tasks — ``submit`` enqueues a block's device programs without
+    synchronizing (jax async dispatch), ``drain`` materializes and writes,
+    so consecutive blocks overlap transfer, compute, and host IO (per-block
+    device latency dominates on tunnel-attached chips).  A generator:
+    consume it fully (side-effect-only drains just iterate it)."""
+    from collections import deque
+
+    pending = deque()
+    for item in items:
+        pending.append(submit(item))
+        if len(pending) > window:
+            yield drain(pending.popleft())
+    while pending:
+        yield drain(pending.popleft())
+
+
 class FailedJobsError(RuntimeError):
     pass
 

@@ -237,16 +237,11 @@ class BlockEdgeFeatures(BlockTask):
                      edge_ids=out_ids.astype("int64"), features=feats)
             log_fn(f"processed block {block_id}")
 
-        from collections import deque
+        from ..core.runtime import stream_window
 
-        window = int(cfg.get("stream_window", 3))
-        pending = deque()
-        for block_id in job_config["block_list"]:
-            pending.append(submit(block_id))
-            if len(pending) > window:
-                drain(pending.popleft())
-        while pending:
-            drain(pending.popleft())
+        for _ in stream_window(job_config["block_list"], submit, drain,
+                               window=int(cfg.get("stream_window", 3))):
+            pass
 
 
 class MergeEdgeFeatures(BlockTask):

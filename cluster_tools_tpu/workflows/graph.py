@@ -19,7 +19,7 @@ import numpy as np
 
 from ..core import graph as g
 from ..core.blocking import Blocking
-from ..core.runtime import BlockTask
+from ..core.runtime import BlockTask, stream_window
 from ..core.storage import file_reader
 from ..core.workflow import Task
 
@@ -57,8 +57,6 @@ class InitialSubGraphs(BlockTask):
 
     @classmethod
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
-        from collections import deque
-
         import jax.numpy as jnp
 
         from ..ops.rag import (densify_labels, device_edge_stats_finalize,
@@ -104,14 +102,9 @@ class InitialSubGraphs(BlockTask):
                              nodes.astype("uint64"), edges)
             log_fn(f"processed block {block_id}")
 
-        window = int(cfg.get("stream_window", 3))
-        pending = deque()
-        for block_id in job_config["block_list"]:
-            pending.append(submit(block_id))
-            if len(pending) > window:
-                drain(pending.popleft())
-        while pending:
-            drain(pending.popleft())
+        for _ in stream_window(job_config["block_list"], submit, drain,
+                               window=int(cfg.get("stream_window", 3))):
+            pass
 
 
 class MergeSubGraphs(BlockTask):

@@ -212,6 +212,8 @@ def iter_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
         raise ValueError(
             f"iter_ws_blocks_stream supports the plain 3d pipeline only; "
             f"{unsupported} need run_ws_block")
+    from ..core.runtime import stream_window
+
     pipeline = _ws_pipeline_3d(
         float(cfg.get("threshold", 0.25)),
         float(cfg.get("sigma_seeds", 2.0)),
@@ -221,16 +223,11 @@ def iter_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
     # bounded look-ahead: dispatch a few blocks ahead, drain as results are
     # consumed — unbounded queueing would hold every output buffer in HBM
     # (~150 MB per reference-size block)
-    window = int(cfg.get("stream_window", 3))
-    from collections import deque
-
-    pending: "deque" = deque()
-    for b in blocks:
-        pending.append(pipeline(jnp.asarray(b)))  # queued async
-        if len(pending) > window:
-            yield np.asarray(pending.popleft()).astype("uint64")
-    while pending:
-        yield np.asarray(pending.popleft()).astype("uint64")
+    yield from stream_window(
+        blocks,
+        lambda b: pipeline(jnp.asarray(b)),          # queued async
+        lambda h: np.asarray(h).astype("uint64"),
+        window=int(cfg.get("stream_window", 3)))
 
 
 def run_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
