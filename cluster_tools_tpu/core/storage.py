@@ -505,9 +505,16 @@ class VarlenDataset:
     matching the reference's race-freedom-by-layout design (SURVEY.md §5.2).
     """
 
-    def __init__(self, path: str, dtype="uint64"):
+    def __init__(self, path: str, dtype="uint64", mode: str = "a"):
         self.path = path
-        os.makedirs(path, exist_ok=True)
+        if mode == "r":
+            # a read must not mutate the container (a typo'd key would
+            # otherwise leave an empty stray directory behind)
+            if not os.path.isdir(path):
+                raise FileNotFoundError(
+                    f"varlen dataset not found: {path}")
+        else:
+            os.makedirs(path, exist_ok=True)
         self.dtype = np.dtype(dtype)
         self.attrs = AttrsView(path, "n5")
 

@@ -19,6 +19,7 @@ from .postprocess import (ConnectedComponentsWorkflow, FilterLabelsWorkflow,
                           FilterOrphansWorkflow,
                           SizeFilterAndGraphWatershedWorkflow,
                           SizeFilterWorkflow)
+from .label_multisets import LabelMultisetWorkflow
 from .learning import LearningWorkflow
 from .lifted_features import LiftedFeaturesFromNodeLabelsWorkflow
 from .lifted_multicut import LiftedMulticutWorkflow
@@ -47,7 +48,7 @@ __all__ = [
     "ConnectedComponentsWorkflow", "FilterLabelsWorkflow",
     "FilterByThresholdWorkflow",
     "FilterOrphansWorkflow", "GraphWorkflow", "InferenceTask",
-    "LearningWorkflow",
+    "LabelMultisetWorkflow", "LearningWorkflow",
     "LiftedFeaturesFromNodeLabelsWorkflow",
     "MorphologyWorkflow", "RegionFeaturesWorkflow", "SkeletonWorkflow",
     "UpsampleSkeletons",

@@ -33,39 +33,67 @@ from .downscaling import DownscaleTask, _factor3
 
 class UniqueBlockLabels(BlockTask):
     """Per-block unique label lists for one scale level (reference:
-    unique_block_labels.py:123-145)."""
+    unique_block_labels.py:123-145, incl. the label-multiset variant —
+    with ``from_multiset`` the input is a multiset level written by
+    workflows/label_multisets.py and uniques come from the multiset ids
+    without touching the dense volume)."""
 
     task_name = "unique_block_labels"
 
     def __init__(self, input_path: str, input_key: str, output_path: str,
-                 output_key: str, identifier: str = "", **kw):
+                 output_key: str, identifier: str = "",
+                 from_multiset: bool = False, **kw):
         self.input_path = input_path
         self.input_key = input_key
         self.output_path = output_path
         self.output_key = output_key
         self.identifier = identifier
+        self.from_multiset = from_multiset
         super().__init__(**kw)
 
     def run_impl(self):
-        with file_reader(self.input_path, "r") as f:
-            shape = list(f[self.input_key].shape)
-        block_shape = [min(b, s) for b, s in
-                       zip(self.global_block_shape(), shape)]
+        if self.from_multiset:
+            src = VarlenDataset(os.path.join(self.input_path,
+                                             self.input_key),
+                                dtype="uint64", mode="r")
+            shape = list(src.attrs["multisetShape"])
+            block_shape = list(src.attrs["blockShape"])
+        else:
+            with file_reader(self.input_path, "r") as f:
+                shape = list(f[self.input_key].shape)
+            block_shape = [min(b, s) for b, s in
+                           zip(self.global_block_shape(), shape)]
         block_list = self.blocks_in_volume(shape, block_shape)
         self.run_jobs(block_list, {
             "input_path": self.input_path, "input_key": self.input_key,
             "output_path": self.output_path, "output_key": self.output_key,
             "shape": shape, "block_shape": block_shape,
+            "from_multiset": self.from_multiset,
         }, n_jobs=self.max_jobs)
 
     @classmethod
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
         cfg = job_config["config"]
+        out = VarlenDataset(os.path.join(cfg["output_path"],
+                                         cfg["output_key"]), dtype="uint64")
+        if cfg.get("from_multiset"):
+            from .label_multisets import load_multiset_block
+
+            src = VarlenDataset(os.path.join(cfg["input_path"],
+                                             cfg["input_key"]),
+                                dtype="uint64", mode="r")
+            for block_id in job_config["block_list"]:
+                entry = load_multiset_block(cfg["input_path"],
+                                            cfg["input_key"], block_id,
+                                            ds=src)
+                ids = (np.zeros(0, "uint64") if entry is None
+                       else np.unique(entry[1]))
+                out.write_chunk((block_id,), ids.astype("uint64"))
+                log_fn(f"processed block {block_id}")
+            return
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
         f_in = file_reader(cfg["input_path"], "r")
         ds = f_in[cfg["input_key"]]
-        out = VarlenDataset(os.path.join(cfg["output_path"],
-                                         cfg["output_key"]), dtype="uint64")
         for block_id in job_config["block_list"]:
             uniques = np.unique(ds[blocking.get_block(block_id).bb])
             out.write_chunk((block_id,), uniques.astype("uint64"))

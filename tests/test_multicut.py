@@ -234,3 +234,47 @@ def _check_recovery(true, seg, n_true=4, min_share=0.95, min_rand=0.95):
     sb = (cb.astype(float) ** 2).sum()
     rand = 2.0 / (sb / sab + sa / sab)
     assert rand >= min_rand, f"rand f-score {rand:.4f} < {min_rand}"
+
+
+def test_solver_quality_planted_partition():
+    """Objective-bound oracle on a larger instance (the reference validates
+    its solvers against a stored-problem objective bound,
+    test/utils/test_segmentation_utils.py:21): on a planted-partition graph
+    the KL-refined solution must (a) improve on or match plain GAEC's
+    objective, (b) reach at least 97% of the planted partition's objective,
+    and (c) recover the planted clusters almost exactly."""
+    from cluster_tools_tpu import native
+    from cluster_tools_tpu.utils.validation import rand_index
+
+    rng = np.random.RandomState(0)
+    n_clusters, per = 8, 12
+    n = n_clusters * per
+    truth = np.repeat(np.arange(n_clusters), per)
+    # dense-ish random graph: all intra edges + random inter edges
+    edges = []
+    costs = []
+    for a in range(n):
+        for b in range(a + 1, n):
+            same = truth[a] == truth[b]
+            if not same and rng.rand() > 0.2:
+                continue
+            edges.append((a, b))
+            # attractive intra, repulsive inter, with noise that flips ~8%
+            base = 1.0 if same else -1.0
+            costs.append(base + rng.randn() * 0.6)
+    uv = np.asarray(edges, "uint64")
+    c = np.asarray(costs, "float64")
+
+    gaec = native.multicut_gaec(n, uv, c)
+    kl = native.multicut_kernighan_lin(n, uv, c)  # GAEC warmstart + refine
+    obj_gaec = native.multicut_objective(uv, c, gaec)
+    obj_kl = native.multicut_objective(uv, c, kl)
+    obj_truth = native.multicut_objective(uv, c, truth)
+
+    # multicut objective = sum of costs of CUT edges; lower is better
+    assert obj_kl <= obj_gaec + 1e-9
+    assert obj_truth < 0  # the 97%-of-optimum bound assumes this sign
+    assert obj_kl <= 0.97 * obj_truth
+    are, _ = rand_index(kl.reshape(1, 1, -1) + 1,
+                        truth.reshape(1, 1, -1) + 1)
+    assert are < 0.05, f"planted partition not recovered (ARE {are:.3f})"
