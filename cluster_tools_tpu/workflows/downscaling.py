@@ -72,13 +72,22 @@ def downsample(x: np.ndarray, factor: Sequence[int],
     return y.astype(x.dtype)
 
 
-def _majority_pool(x: np.ndarray, factor, out_shape) -> np.ndarray:
-    """Mode over each pooling window (label-safe downsampling)."""
-    pad = tuple((0, o * f - s) for s, f, o in zip(x.shape, factor, out_shape))
-    xp = np.pad(x, pad, mode="edge")
+def pooling_windows(x: np.ndarray, factor, out_shape,
+                    pad_mode: str = "edge") -> np.ndarray:
+    """``(out_shape..., prod(factor))`` view of x's pooling windows, with
+    the upper border padded to a factor multiple (shared by the majority
+    pool here and the label-multiset computation)."""
+    pad = tuple((0, o * f - s) for s, f, o in zip(x.shape, factor,
+                                                  out_shape))
+    xp = np.pad(x, pad, mode=pad_mode)
     r = xp.reshape(out_shape[0], factor[0], out_shape[1], factor[1],
                    out_shape[2], factor[2])
-    windows = r.transpose(0, 2, 4, 1, 3, 5).reshape(*out_shape, -1)
+    return r.transpose(0, 2, 4, 1, 3, 5).reshape(*out_shape, -1)
+
+
+def _majority_pool(x: np.ndarray, factor, out_shape) -> np.ndarray:
+    """Mode over each pooling window (label-safe downsampling)."""
+    windows = pooling_windows(x, factor, out_shape)
     w = np.sort(windows, axis=-1)
     # longest run in the sorted window = the mode
     n = w.shape[-1]

@@ -48,18 +48,14 @@ def compute_multisets(fine: np.ndarray, factor: Sequence[int]
     are padded by edge replication and the pad contributions removed from
     the counts, so border voxels carry exactly their real fine voxels.
     """
+    from .downscaling import pooling_windows
+
     out_shape = tuple(-(-s // f) for s, f in zip(fine.shape, factor))
-    pad = tuple((0, o * f - s) for s, f, o in zip(fine.shape, factor,
-                                                  out_shape))
-    padded = np.pad(fine, pad, mode="edge")
+    w = int(np.prod(factor))
+    windows = pooling_windows(fine, factor, out_shape).reshape(-1, w)
     # pad-tracking: count only real voxels
-    real = np.pad(np.ones(fine.shape, "int64"), pad, mode="constant")
-    r = padded.reshape(out_shape[0], factor[0], out_shape[1], factor[1],
-                       out_shape[2], factor[2])
-    windows = r.transpose(0, 2, 4, 1, 3, 5).reshape(-1, int(np.prod(factor)))
-    rmask = real.reshape(out_shape[0], factor[0], out_shape[1], factor[1],
-                         out_shape[2], factor[2]
-                         ).transpose(0, 2, 4, 1, 3, 5).reshape(windows.shape)
+    rmask = pooling_windows(np.ones(fine.shape, "int64"), factor,
+                            out_shape, pad_mode="constant").reshape(-1, w)
     n, w = windows.shape
     order = np.argsort(windows, axis=1, kind="stable")
     sw = np.take_along_axis(windows, order, axis=1)
@@ -107,19 +103,19 @@ def unpack_multiset_block(flat: np.ndarray
     return offsets, ids, counts
 
 
-def merge_multisets(entries, parent_of, n_parents: int
+def merge_multisets(entries, n_parents: int
                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Union child multisets into parent multisets (exact: pooling windows
     partition the volume, so summing child counts per id is byte-identical
     to recomputing from level 0).
 
-    ``entries`` = iterable of (child_voxel_ids[int64], ids, counts) flat
-    triples; ``parent_of[child_voxel_id] -> parent voxel index``.  Returns
-    (offsets[n_parents + 1], ids, counts) sorted by (parent, id).
+    ``entries`` = iterable of (parent_voxel_indices[int64], ids, counts)
+    flat triples.  Returns (offsets[n_parents + 1], ids, counts) sorted by
+    (parent, id).
     """
     all_parents, all_ids, all_counts = [], [], []
-    for child_vox, ids, counts in entries:
-        all_parents.append(parent_of[child_vox])
+    for parent_vox, ids, counts in entries:
+        all_parents.append(parent_vox)
         all_ids.append(ids)
         all_counts.append(counts)
     if not all_parents:
@@ -242,29 +238,26 @@ class LabelMultisetTask(BlockTask):
                     continue
                 coffsets, cids, ccounts = unpack_multiset_block(flat)
                 cblock = child_blocking.get_block(cbid)
-                cshape = [b.stop - b.start for b in cblock.bb]
-                # global child voxel coords of this child block, C-order
-                zz, yy, xx = np.meshgrid(
-                    *[np.arange(b.start, b.stop) for b in cblock.bb],
-                    indexing="ij")
-                inside = np.ones(cshape, bool)
-                for ax, (g, (lo, hi)) in enumerate(zip((zz, yy, xx),
-                                                       child_bb)):
-                    inside &= (g >= lo) & (g < hi)
-                # parent voxel index (within this parent block) per child
-                pz = zz // factor[0] - block.bb[0].start
-                py = yy // factor[1] - block.bb[1].start
-                px = xx // factor[2] - block.bb[2].start
-                pidx = (pz * pshape[1] + py) * pshape[2] + px
+                # per-axis 1-D coords broadcast to the block's C-order
+                # voxel grid (no dense meshgrids)
+                ax_coord = [np.arange(b.start, b.stop) for b in cblock.bb]
+                ax_inside = [(c >= lo) & (c < hi)
+                             for c, (lo, hi) in zip(ax_coord, child_bb)]
+                ax_parent = [c // f - b.start
+                             for c, f, b in zip(ax_coord, factor, block.bb)]
+                inside = (ax_inside[0][:, None, None]
+                          & ax_inside[1][None, :, None]
+                          & ax_inside[2][None, None, :])
+                pidx = ((ax_parent[0][:, None, None] * pshape[1]
+                         + ax_parent[1][None, :, None]) * pshape[2]
+                        + ax_parent[2][None, None, :])
                 # expand per-voxel offsets to per-entry rows
                 lens = np.diff(coffsets)
                 vox_of_entry = np.repeat(np.arange(len(lens)), lens)
                 keep = inside.ravel()[vox_of_entry]
                 entries.append((pidx.ravel()[vox_of_entry[keep]],
                                 cids[keep], ccounts[keep]))
-            offsets, ids, counts = merge_multisets(
-                [(p, i, c) for p, i, c in entries],
-                np.arange(n_parents, dtype="int64"), n_parents)
+            offsets, ids, counts = merge_multisets(entries, n_parents)
             out.write_chunk((block_id,),
                             pack_multiset_block(offsets, ids, counts))
             log_fn(f"processed block {block_id}")

@@ -212,21 +212,43 @@ def iter_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
         raise ValueError(
             f"iter_ws_blocks_stream supports the plain 3d pipeline only; "
             f"{unsupported} need run_ws_block")
-    from ..core.runtime import stream_window
+    import jax
 
+    from ..core.runtime import stream_window
+    from ..ops.watershed import size_filter
+
+    min_size = int(cfg.get("size_filter", 25) or 0)
+    # the fused on-device size filter (bincount + regrow in the jitted
+    # program) avoids the height/label host round-trip that dominates on
+    # accelerators, but its full-length bincount and second flood are a
+    # net loss on the CPU backend — there the host size filter is faster.
+    # cfg["fuse_size_filter"] overrides the backend default (tests force
+    # both paths on the CPU mesh).
+    fuse_filter = cfg.get("fuse_size_filter")
+    if fuse_filter is None:
+        fuse_filter = jax.default_backend() != "cpu"
     pipeline = _ws_pipeline_3d(
         float(cfg.get("threshold", 0.25)),
         float(cfg.get("sigma_seeds", 2.0)),
         float(cfg.get("sigma_weights", 2.0)),
         float(cfg.get("alpha", 0.8)),
-        int(cfg.get("size_filter", 25) or 0))
+        min_size if fuse_filter else 0,
+        return_height=not fuse_filter and bool(min_size))
+
+    def drain(handles):
+        if fuse_filter or not min_size:
+            return np.asarray(handles).astype("uint64")
+        ws, height = handles
+        return size_filter(np.asarray(ws), np.asarray(height),
+                           min_size).astype("uint64")
+
     # bounded look-ahead: dispatch a few blocks ahead, drain as results are
     # consumed — unbounded queueing would hold every output buffer in HBM
     # (~150 MB per reference-size block)
     yield from stream_window(
         blocks,
         lambda b: pipeline(jnp.asarray(b)),          # queued async
-        lambda h: np.asarray(h).astype("uint64"),
+        drain,
         window=int(cfg.get("stream_window", 3)))
 
 
@@ -237,7 +259,8 @@ def run_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
 
 @lru_cache(maxsize=8)
 def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
-                    sigma_weights: float, alpha: float, min_size: int = 0):
+                    sigma_weights: float, alpha: float, min_size: int = 0,
+                    return_height: bool = False):
     """Cached fused jitted pipeline — one compile per parameter set (the
     jit cache lives on the returned function, so re-creating the closure per
     call would recompile every time).  With ``min_size`` the size filter is
@@ -273,6 +296,8 @@ def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
             small = small.at[0].set(False)
             kept = jnp.where(small[ws], 0, ws)
             ws = seeded_watershed(height, kept, None, connectivity=1)
+        if return_height:  # for a host-side size filter downstream
+            return ws, height
         return ws
 
     return pipeline

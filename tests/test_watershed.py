@@ -301,8 +301,9 @@ def test_watershed_nms_reduces_fragments(tmp_workdir, tmp_path):
 
 
 def test_streamed_pipeline_matches_blockwise_with_size_filter():
-    """The fused on-device size filter (bincount + regrow inside the jitted
-    pipeline) matches run_ws_block's host size_filter path."""
+    """Both streamed size-filter paths — fused on-device (bincount + regrow
+    inside the jitted pipeline, the accelerator default) and host-side (the
+    CPU-backend default) — match run_ws_block's host size_filter path."""
     from cluster_tools_tpu.workflows.watershed import (run_ws_block,
                                                        run_ws_blocks_stream)
 
@@ -310,5 +311,7 @@ def test_streamed_pipeline_matches_blockwise_with_size_filter():
     cfg = {"threshold": 0.5, "sigma_seeds": 2.0, "sigma_weights": 2.0,
            "alpha": 0.8, "size_filter": 40}
     single = run_ws_block(vol, cfg)
-    streamed = run_ws_blocks_stream([vol], cfg)[0]
-    np.testing.assert_array_equal(streamed, single)
+    for fuse in (True, False):
+        streamed = run_ws_blocks_stream(
+            [vol], {**cfg, "fuse_size_filter": fuse})[0]
+        np.testing.assert_array_equal(streamed, single)
