@@ -2,3 +2,4 @@ from .mesh import make_mesh, volume_sharding, param_sharding, replicated
 from .stencil import halo_exchange, crop_halo, sharded_stencil
 from .pipeline import make_pipe_mesh, pipeline_apply, stack_stage_params
 from .experts import make_expert_mesh, moe_apply
+from .ring_attention import make_seq_mesh, ring_attention

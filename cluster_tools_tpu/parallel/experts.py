@@ -81,7 +81,6 @@ def moe_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
 
 def make_expert_mesh(n_experts: int, n_devices: int = None) -> Mesh:
     """Mesh with a single ``expert`` axis (one expert per device)."""
-    devices = jax.devices()
-    n = n_devices or len(devices)
-    assert n_experts == n, (n_experts, n)
-    return Mesh(np.array(devices[:n]), ("expert",))
+    from .mesh import single_axis_mesh
+
+    return single_axis_mesh("expert", n_experts, n_devices)

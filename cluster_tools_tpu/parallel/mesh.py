@@ -94,3 +94,13 @@ def param_sharding(mesh: Mesh, params) -> Dict:
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map(leaf_spec, params)
+
+
+def single_axis_mesh(axis: str, n_shards: int,
+                     n_devices: Optional[int] = None) -> Mesh:
+    """Mesh with one named axis spanning the first ``n_shards`` devices
+    (shared constructor for the expert/seq single-axis meshes)."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    assert n_shards == n, (n_shards, n)
+    return Mesh(np.array(devices[:n]), (axis,))
