@@ -51,10 +51,11 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def body(ql, kl, vl):
         my = jax.lax.axis_index(axis)
         q_pos = my * t_local + jnp.arange(t_local)          # global rows
+        qf = ql.astype(jnp.float32)  # accumulate in f32 (bf16-safe)
 
-        def hop(step, carry):
-            kc, vc, m, l, o = carry
-            s = jnp.einsum("thd,shd->hts", ql, kc) * scale  # (H, tq, tk)
+        def attend(step, kc, vc, m, l, o):
+            s = jnp.einsum("thd,shd->hts", qf,
+                           kc.astype(jnp.float32)) * scale  # (H, tq, tk)
             if causal:
                 # the resident chunk at hop `step` originated at shard
                 # (my + step) % n_shards — no collective needed to track it
@@ -71,10 +72,16 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             p = jnp.exp(s - m_safe[:, :, None])
             l_new = l * corr + p.sum(axis=2)
             o_new = (o * corr[..., None]
-                     + jnp.einsum("hts,shd->thd", p, vc).transpose(1, 0, 2))
+                     + jnp.einsum("hts,shd->thd", p,
+                                  vc.astype(jnp.float32)).transpose(1, 0, 2))
+            return m_new, l_new, o_new
+
+        def hop(step, carry):
+            kc, vc, m, l, o = carry
+            m, l, o = attend(step, kc, vc, m, l, o)
             kc = jax.lax.ppermute(kc, axis, perm)
             vc = jax.lax.ppermute(vc, axis, perm)
-            return kc, vc, m_new, l_new, o_new
+            return kc, vc, m, l, o
 
         # initial accumulators must be marked device-varying over the ring
         # axis (the loop makes them varying via the per-shard partials)
@@ -84,13 +91,16 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             return jax.lax.pvary(a, (axis,))
 
         h, d = ql.shape[1], ql.shape[2]
-        m0 = _varying(jnp.full((h, t_local), -jnp.inf))
-        l0 = _varying(jnp.zeros((h, t_local)))
-        o0 = _varying(jnp.zeros((h, t_local, d)))
+        m0 = _varying(jnp.full((h, t_local), -jnp.inf, jnp.float32))
+        l0 = _varying(jnp.zeros((h, t_local), jnp.float32))
+        o0 = _varying(jnp.zeros((h, t_local, d), jnp.float32))
         carry = (kl, vl, m0, l0, o0)
-        _, _, m, l, o = jax.lax.fori_loop(0, n_shards, hop, carry)
+        # the final hop attends without rotating (its permuted chunk would
+        # be discarded — one full K+V ICI transfer saved per call)
+        kc, vc, m, l, o = jax.lax.fori_loop(0, n_shards - 1, hop, carry)
+        m, l, o = attend(n_shards - 1, kc, vc, m, l, o)
         out = o / jnp.maximum(l[..., None], 1e-30)
-        return out.transpose(1, 0, 2)                       # (t, H, D)
+        return out.transpose(1, 0, 2).astype(ql.dtype)      # (t, H, D)
 
     spec = P(axis)
     return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
