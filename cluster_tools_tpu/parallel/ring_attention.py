@@ -51,11 +51,11 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def body(ql, kl, vl):
         my = jax.lax.axis_index(axis)
         q_pos = my * t_local + jnp.arange(t_local)          # global rows
-        qf = ql.astype(jnp.float32)  # accumulate in f32 (bf16-safe)
 
         def attend(step, kc, vc, m, l, o):
-            s = jnp.einsum("thd,shd->hts", qf,
-                           kc.astype(jnp.float32)) * scale  # (H, tq, tk)
+            # bf16 operands at full MXU rate, f32 accumulation
+            s = jnp.einsum("thd,shd->hts", ql, kc,
+                           preferred_element_type=jnp.float32) * scale
             if causal:
                 # the resident chunk at hop `step` originated at shard
                 # (my + step) % n_shards — no collective needed to track it
@@ -72,8 +72,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             p = jnp.exp(s - m_safe[:, :, None])
             l_new = l * corr + p.sum(axis=2)
             o_new = (o * corr[..., None]
-                     + jnp.einsum("hts,shd->thd", p,
-                                  vc.astype(jnp.float32)).transpose(1, 0, 2))
+                     + jnp.einsum("hts,shd->thd", p, vc,
+                                  preferred_element_type=jnp.float32
+                                  ).transpose(1, 0, 2))
             return m_new, l_new, o_new
 
         def hop(step, carry):
