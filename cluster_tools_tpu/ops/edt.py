@@ -36,6 +36,22 @@ _TM, _TI, _TJ = 16, 256, 512
 
 def _minplus_pallas(flat: jnp.ndarray, spacing: float,
                     interpret: bool = False) -> jnp.ndarray:
+    """vmap-safe wrapper over :func:`_minplus_pallas_impl`: jax's pallas
+    batching rule prepends the batch dim to the GRID without remapping the
+    kernel's program_id axes, which would silently scramble the i/j tile
+    offsets — sequential_vmap lowers any vmap over this function to a
+    lax.map instead (correct, per-slice).  Batched callers should prefer
+    folding leading axes into the scanline dim (as _minplus_axis does)."""
+
+    @jax.custom_batching.sequential_vmap
+    def call(f):
+        return _minplus_pallas_impl(f, spacing, interpret)
+
+    return call(flat)
+
+
+def _minplus_pallas_impl(flat: jnp.ndarray, spacing: float,
+                         interpret: bool = False) -> jnp.ndarray:
     """Tiled Pallas min-plus product: out[m, i] = min_j flat[m, j] + ((i-j)s)².
 
     The XLA formulation materializes a (rows, n, n) broadcast in HBM per
@@ -50,9 +66,9 @@ def _minplus_pallas(flat: jnp.ndarray, spacing: float,
 
     m, n = flat.shape
     n_128 = -(-n // 128) * 128
-    # largest tuned tiles that divide the padded axis (lane multiples)
-    ti = max(t for t in (128, 256, _TI) if t <= _TI and n_128 % t == 0)
-    tj = max(t for t in (128, 256, 512, _TJ) if t <= _TJ and n_128 % t == 0)
+    # largest tuned tiles that divide the padded axis (128 always does)
+    ti = max(t for t in (128, _TI) if n_128 % t == 0)
+    tj = max(t for t in (128, 256, _TJ) if n_128 % t == 0)
     m_pad = -(-m // _TM) * _TM
     f = jnp.pad(flat, ((0, m_pad - m), (0, n_128 - n)),
                 constant_values=_BIG)  # padded j never wins the min
@@ -100,14 +116,14 @@ def _use_pallas() -> bool:
 
 
 def _minplus_axis(dsq: jnp.ndarray, axis: int, spacing: float,
-                  tile: int = 4096) -> jnp.ndarray:
+                  tile: int = 4096, use_pallas: bool = False) -> jnp.ndarray:
     """One axis of the separable EDT: out[..., i] = min_j dsq[..., j] + ((i-j)s)²."""
     n = dsq.shape[axis]
     xm = jnp.moveaxis(dsq, axis, -1)
     lead_shape = xm.shape[:-1]
     flat = xm.reshape(-1, n)
 
-    if _use_pallas():
+    if use_pallas:
         out = _minplus_pallas(flat, spacing)
         return jnp.moveaxis(out.reshape(*lead_shape, n), -1, axis)
 
@@ -130,26 +146,38 @@ def _minplus_axis(dsq: jnp.ndarray, axis: int, spacing: float,
     return jnp.moveaxis(out.reshape(*lead_shape, n), -1, axis)
 
 
-@partial(jax.jit, static_argnames=("sampling", "tile"))
+@partial(jax.jit, static_argnames=("sampling", "tile", "axes", "use_pallas"))
+def _edt_impl(mask, sampling, tile, axes, use_pallas):
+    mask = mask.astype(bool)
+    sampling = sampling or (1.0,) * mask.ndim
+    dsq = jnp.where(mask, _BIG, 0.0).astype(jnp.float32)
+    for ax in axes if axes is not None else range(mask.ndim):
+        dsq = _minplus_axis(dsq, ax, float(sampling[ax]), tile=tile,
+                            use_pallas=use_pallas)
+    return jnp.sqrt(dsq)
+
+
 def distance_transform_edt(
     mask: jnp.ndarray,
     sampling: Optional[Tuple[float, ...]] = None,
     tile: int = 65536,
+    axes: Optional[Tuple[int, ...]] = None,
 ) -> jnp.ndarray:
     """Exact EDT of a boolean mask: distance of each foreground (True) voxel
     to the nearest background voxel (scipy.ndimage.distance_transform_edt
     convention; vigra's boundaryDistanceTransform differs only in the source
     set).  ``sampling`` is the per-axis voxel pitch (anisotropy support, used
-    by the reference for 2d-DT over anisotropic EM stacks)."""
-    mask = mask.astype(bool)
-    sampling = sampling or (1.0,) * mask.ndim
-    dsq = jnp.where(mask, _BIG, 0.0).astype(jnp.float32)
-    for ax in range(mask.ndim):
-        dsq = _minplus_axis(dsq, ax, float(sampling[ax]), tile=tile)
-    return jnp.sqrt(dsq)
+    by the reference for 2d-DT over anisotropic EM stacks).  ``axes``
+    restricts the transform to a subset of axes — ``axes=(1, 2)`` on a 3d
+    stack is the per-slice 2d EDT without any vmap (untransformed axes fold
+    into the scanline batch).
+
+    The kernel backend is chosen OUTSIDE the jit trace (the env override
+    ``CTT_EDT_PALLAS`` takes effect on the next call, not only the next
+    trace)."""
+    return _edt_impl(mask, sampling, tile, axes, _use_pallas())
 
 
-@partial(jax.jit, static_argnames=("sampling", "tile"))
 def signed_distance_transform(
     mask: jnp.ndarray,
     sampling: Optional[Tuple[float, ...]] = None,
@@ -157,5 +185,5 @@ def signed_distance_transform(
 ) -> jnp.ndarray:
     """Positive inside the mask, negative outside."""
     inner = distance_transform_edt(mask, sampling, tile)
-    outer = distance_transform_edt(~mask, sampling, tile)
+    outer = distance_transform_edt(jnp.logical_not(mask), sampling, tile)
     return inner - outer

@@ -124,7 +124,10 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
     if jmask is not None:
         fg = fg & jmask
     if dt_2d or ws_2d:
-        dt = jax.vmap(lambda m: distance_transform_edt(m))(fg)
+        # per-slice 2d EDT via the axes parameter: slices fold into the
+        # scanline batch (a vmap here would scramble the Pallas kernel's
+        # grid indices — ops/edt.py handles the batching natively)
+        dt = distance_transform_edt(fg, axes=(1, 2))
     else:
         sampling = tuple(pixel_pitch) if pixel_pitch else None
         dt = distance_transform_edt(fg, sampling=sampling)

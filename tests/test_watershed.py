@@ -335,3 +335,28 @@ def test_pallas_minplus_kernel_matches_oracle():
         expect = (flat[:, None, :] + cost[None]).min(-1)
         np.testing.assert_allclose(out, expect, rtol=1e-6,
                                    err_msg=str((m, n, s)))
+
+
+def test_edt_axes_and_vmap_safety():
+    """axes=(1,2) folds slices into the scanline batch (per-slice 2d EDT,
+    no vmap); and vmapping the pallas kernel must stay correct — jax's
+    pallas batching rule would scramble the grid's program_id axes, which
+    sequential_vmap prevents (regression)."""
+    import jax
+
+    from cluster_tools_tpu.ops.edt import (_minplus_pallas,
+                                           distance_transform_edt)
+
+    rng = np.random.RandomState(0)
+    mask = rng.rand(5, 30, 31) > 0.4
+    got = np.asarray(distance_transform_edt(jnp.asarray(mask), axes=(1, 2)))
+    want = np.stack([ndimage.distance_transform_edt(m) for m in mask])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    f = rng.rand(3, 6, 37).astype("float32") * 10
+    out = np.asarray(jax.vmap(
+        lambda x: _minplus_pallas(x, 1.0, interpret=True))(jnp.asarray(f)))
+    idx = np.arange(37, dtype="float32")
+    cost = (idx[:, None] - idx[None, :]) ** 2
+    want = (f[:, :, None, :] + cost[None, None]).min(-1)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
