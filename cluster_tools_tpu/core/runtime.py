@@ -107,6 +107,18 @@ def parse_job_runtime(log_path: str) -> Optional[float]:
     return (last - first).total_seconds()
 
 
+def prefetch_iter(items, load, window: int = 2):
+    """Iterate ``load(item)`` results with a bounded thread-pool look-ahead
+    (tensorstore/h5 reads release the GIL, so upcoming blocks load while
+    the caller computes).  Yields in input order — the same bounded window
+    as :func:`stream_window`, with futures as the in-flight handles."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=window) as pool:
+        yield from stream_window(items, lambda it: pool.submit(load, it),
+                                 lambda fut: fut.result(), window=window)
+
+
 def stream_window(items, submit, drain, window: int = 3):
     """Bounded submit/drain pipeline over ``items``: keep up to ``window``
     submitted entries in flight before draining the oldest, yielding each

@@ -125,7 +125,9 @@ class BlockEdgeFeatures(BlockTask):
         # synchronizing, drain materializes and writes — block i+1's
         # transfers/compute overlap block i's readback + IO (per-block
         # device latency dominates on tunnel-attached chips)
-        def submit(block_id: int):
+        def load(block_id: int):
+            """Host IO only (runs on the prefetch threads): geometry, label
+            + map reads, sub-graph load."""
             block = blocking.get_block(block_id)
             if offsets is None:
                 begin = list(block.begin)
@@ -139,13 +141,33 @@ class BlockEdgeFeatures(BlockTask):
                 end = [min(e + int(r), s)
                        for e, r, s in zip(block.end, reach, cfg["shape"])]
             bb = tuple(slice(b, e) for b, e in zip(begin, end))
-            lut, dense = densify_labels(ds_lab[bb])
             data = g.load_sub_graph(cfg["graph_path"], 0, block_id)
+            if len(data["edges"]) == 0 and offsets is None:
+                # empty local sub-graph: no map/label read needed (affinity
+                # mode still proceeds — the block may own seam anchors)
+                return block_id, None, None, None, None, None, None, data
+            labels = np.asarray(ds_lab[bb])
+            if responses:
+                halo_v = int(4.0 * max(cfg["sigmas"]) + 0.5) + 1
+                obegin = [max(b - halo_v, 0) for b in begin]
+                oend = [min(e + halo_v, s)
+                        for e, s in zip(end, cfg["shape"])]
+                obb = tuple(slice(b, e) for b, e in zip(obegin, oend))
+                raw = np.asarray(ds_in[obb])
+            elif offsets is None:
+                obegin = begin
+                raw = np.asarray(ds_in[bb])
+            else:
+                obegin = begin
+                raw = np.asarray(ds_in[(slice(0, len(offsets)),) + bb])
+            return block_id, block, begin, end, obegin, labels, raw, data
+
+        def submit(entry):
+            block_id, block, begin, end, obegin, labels, raw, data = entry
             edges, edge_ids = data["edges"], data["edge_ids"]
-            # affinity mode must proceed even with an empty local sub-graph:
-            # this block may still own anchor samples of seam edges
             if len(edges) == 0 and offsets is None:
                 return block_id, None, None, None, None
+            lut, dense = densify_labels(labels)
             if responses:
                 # filter-bank features: one device filter response per
                 # (filter, sigma), each accumulated with the same boundary
@@ -157,16 +179,11 @@ class BlockEdgeFeatures(BlockTask):
 
                 import jax
 
-                halo_v = int(4.0 * max(cfg["sigmas"]) + 0.5) + 1
-                obegin = [max(b - halo_v, 0) for b in begin]
-                oend = [min(e + halo_v, s)
-                        for e, s in zip(end, cfg["shape"])]
-                obb = tuple(slice(b, e) for b, e in zip(obegin, oend))
-                raw = jnp.asarray(ds_in[obb].astype("float32") / scale)
+                raw_dev = jnp.asarray(raw.astype("float32") / scale)
                 local = tuple(slice(b - ob, e - ob)
                               for b, ob, e in zip(begin, obegin, end))
                 dense_dev = jnp.asarray(dense)
-                resp_stack = jnp.stack([apply_filter(raw, fn, s)[local]
+                resp_stack = jnp.stack([apply_filter(raw_dev, fn, s)[local]
                                         for fn, s in responses])
                 # u/v/ok derive from the labels only, so under vmap they
                 # stay unbatched and the O(volume) pair extraction runs
@@ -179,7 +196,7 @@ class BlockEdgeFeatures(BlockTask):
                                                     e_max=e_max)
                            for k in range(len(responses))]
             elif offsets is None:
-                bmap = ds_in[bb].astype("float32") / scale
+                bmap = raw.astype("float32") / scale
                 u, v, val, ok = boundary_pair_values(
                     jnp.asarray(dense), jnp.asarray(bmap),
                     inner_shape=tuple(block.shape))
@@ -189,8 +206,7 @@ class BlockEdgeFeatures(BlockTask):
                 handles = [device_edge_stats_submit(u, v, val, ok,
                                                     e_max=e_max)]
             else:
-                affs = ds_in[(slice(0, len(offsets)),) + bb]
-                affs = affs.astype("float32") / scale
+                affs = raw.astype("float32") / scale
                 u, v, val, ok = affinity_pair_values(
                     jnp.asarray(dense), jnp.asarray(affs), offsets,
                     inner_begin=tuple(b - bo for b, bo in
@@ -237,9 +253,10 @@ class BlockEdgeFeatures(BlockTask):
                      edge_ids=out_ids.astype("int64"), features=feats)
             log_fn(f"processed block {block_id}")
 
-        from ..core.runtime import stream_window
+        from ..core.runtime import prefetch_iter, stream_window
 
-        for _ in stream_window(job_config["block_list"], submit, drain,
+        for _ in stream_window(prefetch_iter(job_config["block_list"], load),
+                               submit, drain,
                                window=int(cfg.get("stream_window", 3))):
             pass
 

@@ -69,15 +69,19 @@ class InitialSubGraphs(BlockTask):
         f = file_reader(cfg["input_path"], "r")
         ds = f[cfg["input_key"]]
 
-        # two-stage pipeline over the job's blocks: submit enqueues the
-        # device programs without synchronizing, drain materializes —
-        # block i+1's transfer/compute overlap block i's readback + IO
-        def submit(block_id: int):
+        # three-stage pipeline over the job's blocks: threaded read
+        # look-ahead feeds submit, submit enqueues the device programs
+        # without synchronizing, drain materializes — block i+1's
+        # transfer/compute overlap block i's readback + IO
+        def load(block_id: int):
             block = blocking.get_block(block_id)
             # +1 halo on upper faces only, clipped at the volume border
             end = [min(e + 1, s) for e, s in zip(block.end, cfg["shape"])]
             bb = tuple(slice(b, e) for b, e in zip(block.begin, end))
-            labels = ds[bb]
+            return block_id, block, np.asarray(ds[bb])
+
+        def submit(entry):
+            block_id, block, labels = entry
             lut, dense = densify_labels(labels)
             # nodes straight from the densification LUT (sorted uniques
             # with 0 prepended) — no second full-block unique, and the
@@ -102,7 +106,10 @@ class InitialSubGraphs(BlockTask):
                              nodes.astype("uint64"), edges)
             log_fn(f"processed block {block_id}")
 
-        for _ in stream_window(job_config["block_list"], submit, drain,
+        from ..core.runtime import prefetch_iter
+
+        for _ in stream_window(prefetch_iter(job_config["block_list"], load),
+                               submit, drain,
                                window=int(cfg.get("stream_window", 3))):
             pass
 

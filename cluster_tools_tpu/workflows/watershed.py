@@ -508,10 +508,15 @@ class WatershedTask(BlockTask):
                       and not cfg.get("pixel_pitch")
                       and not cfg.get("non_maximum_suppression"))
         if streamable:
+            from ..core.runtime import prefetch_iter
+
             block_ids = list(job_config["block_list"])
-            reads = (_read_padded_input(ds_in, blocking.get_block(bid),
-                                        cfg, halo)
-                     for bid in block_ids)
+            # threaded read look-ahead: block i+2's store read overlaps
+            # block i's device compute and block i-1's write
+            reads = prefetch_iter(
+                block_ids,
+                lambda bid: _read_padded_input(
+                    ds_in, blocking.get_block(bid), cfg, halo))
             for bid, ws in zip(block_ids,
                                iter_ws_blocks_stream(reads, cfg)):
                 _write_result(bid, ws)
