@@ -141,3 +141,50 @@ class CheckComponents(BlockTask):
             json.dump(disconnected, fo)
         log_fn(f"{len(disconnected)} disconnected segments of "
                f"{cfg['n_labels']}")
+
+
+class CheckWsWorkflow:
+    """Verify a watershed has exactly one connected component per label
+    (reference: debugging/check_ws_workflow.py:13-49 — chains unique-labels
+    + label-block mapping + a component check; here the morphology table's
+    bounding boxes shard the component re-check directly).  Writes the list
+    of violating fragment ids as JSON at ``output_path``.
+
+    Constructed like a workflow task::
+
+        wf = CheckWsWorkflow(ws_path=..., ws_key=..., debug_path=...,
+                             output_path=..., tmp_folder=..., config_dir=...,
+                             max_jobs=..., target=...)
+        ctt.build([wf.task()])
+    """
+
+    def __init__(self, ws_path: str, ws_key: str, debug_path: str,
+                 output_path: str, tmp_folder: str, config_dir: str,
+                 max_jobs: int = 1, target: str = "local",
+                 n_labels=None, dependency=None):
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.debug_path = debug_path
+        self.output_path = output_path
+        self.common = dict(tmp_folder=tmp_folder, config_dir=config_dir,
+                           max_jobs=max_jobs, target=target)
+        self.n_labels = n_labels
+        self.dependency = dependency
+
+    def task(self):
+        from .morphology import MorphologyWorkflow
+
+        n_labels = self.n_labels
+        if n_labels is None:
+            with file_reader(self.ws_path, "r") as f:
+                n_labels = int(f[self.ws_key].attrs["maxId"]) + 1
+        morpho = MorphologyWorkflow(
+            input_path=self.ws_path, input_key=self.ws_key,
+            output_path=self.debug_path, output_key="morphology",
+            n_labels=n_labels, prefix="check_ws",
+            dependency=self.dependency, **self.common)
+        return CheckComponents(
+            seg_path=self.ws_path, seg_key=self.ws_key,
+            morphology_path=self.debug_path, morphology_key="morphology",
+            n_labels=n_labels, output_path=self.output_path,
+            dependency=morpho, **self.common)
