@@ -133,7 +133,7 @@ def stream_window(items, submit, drain, window: int = 3):
     pending = deque()
     for item in items:
         pending.append(submit(item))
-        if len(pending) > window:
+        if len(pending) >= window:
             yield drain(pending.popleft())
     while pending:
         yield drain(pending.popleft())

@@ -45,12 +45,23 @@ def make_torch_predictor(checkpoint_path: str, outer_shape: Sequence[int],
         if x.ndim == ndim:  # single channel -> (C=1, *outer)
             x = x[None]
         spatial = tuple(range(1, x.ndim))
-        # per-channel statistics, matching the 'self' predictor and the
-        # reference preprocessor (inference/frameworks.py:137-161)
+        # 'standardize' uses per-channel statistics over ALL voxels (the
+        # 'self' predictor's convention).  The reference torch preprocessor
+        # (inference/frameworks.py normalize) instead uses statistics over
+        # NONZERO voxels with an additive eps — checkpoints trained under
+        # the reference pipeline should use 'standardize_nonzero' to see
+        # identically scaled inputs.
         if preprocess == "standardize":
             mean = x.mean(axis=spatial, keepdims=True)
             std = np.maximum(x.std(axis=spatial, keepdims=True), 1e-6)
             x = (x - mean) / std
+        elif preprocess == "standardize_nonzero":
+            nz = x != 0
+            cnt = np.maximum(nz.sum(axis=spatial, keepdims=True), 1)
+            mean = (x * nz).sum(axis=spatial, keepdims=True) / cnt
+            var = (((x - mean) * nz) ** 2).sum(axis=spatial,
+                                               keepdims=True) / cnt
+            x = (x - mean) / (np.sqrt(var) + 1e-6)
         elif preprocess == "normalize":
             lo = x.min(axis=spatial, keepdims=True)
             hi = x.max(axis=spatial, keepdims=True)

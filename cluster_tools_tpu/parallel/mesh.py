@@ -99,8 +99,10 @@ def param_sharding(mesh: Mesh, params) -> Dict:
 def single_axis_mesh(axis: str, n_shards: int,
                      n_devices: Optional[int] = None) -> Mesh:
     """Mesh with one named axis spanning the first ``n_shards`` devices
-    (shared constructor for the expert/seq single-axis meshes)."""
+    (shared constructor for the expert/seq single-axis meshes).  A mesh over
+    a device subset (``n_shards < n_devices``) is allowed."""
     devices = jax.devices()
     n = n_devices or len(devices)
-    assert n_shards == n, (n_shards, n)
-    return Mesh(np.array(devices[:n]), (axis,))
+    if n_shards > n:
+        raise ValueError(f"need {n_shards} devices, have {n}")
+    return Mesh(np.array(devices[:n_shards]), (axis,))

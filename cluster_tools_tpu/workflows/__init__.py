@@ -4,7 +4,8 @@ from .affinities import InsertAffinities, SmoothedGradients
 from .copy_volume import CopyVolumeTask
 from .debugging import CheckComponents, CheckSubGraphs
 from .decomposition import DecompositionWorkflow
-from .downscaling import DownscalingWorkflow
+from .downscaling import (DownscalingWorkflow, PainteraToBdvWorkflow,
+                          ScaleToBoundariesTask, UpscaleTask)
 from .graph import GraphWorkflow
 from .inference import InferenceTask
 from .masking import BlocksFromMask, MinFilterMask
@@ -40,6 +41,7 @@ from .watershed import (AgglomerateTask, WatershedFromSeedsTask,
 __all__ = [
     "BigcatWorkflow", "BlocksFromMask", "CheckComponents", "CheckSubGraphs",
     "CopyVolumeTask", "DecompositionWorkflow", "DownscalingWorkflow",
+    "PainteraToBdvWorkflow", "ScaleToBoundariesTask", "UpscaleTask",
     "ImageFilterTask", "InsertAffinities", "MeshWorkflow", "MinFilterMask",
     "WriteCarving",
     "PainteraConversionWorkflow", "PixelClassificationWorkflow",

@@ -119,6 +119,379 @@ def upsample(x: np.ndarray, factor: Sequence[int],
                      factor[2], 2)
 
 
+class UpscaleTask(BlockTask):
+    """Blockwise up-scaling of a coarse volume to a finer grid (reference:
+    upscaling.py ``UpscalingBase`` / ``_upsample_block``, upscaling.py:206-257).
+
+    Blocks cover the OUTPUT (fine) volume; each block loads the covering
+    coarse window, resizes it on device (``'interpolate'`` — the
+    vigra.sampling.resize analog) or repeats it (``'nearest'``, label-safe),
+    and crops the exact window.  Empty coarse windows are skipped; uint8/16
+    outputs are rounded and clipped like the reference."""
+
+    task_name = "upscaling"
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, scale_factor: ScaleFactor,
+                 identifier: str = "", **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.scale_factor = _factor3(scale_factor)
+        self.identifier = identifier
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"sampler": "nearest"})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            ds = f[self.input_key]
+            in_shape = list(ds.shape)
+            dtype = str(ds.dtype)
+        out_shape = [s * f for s, f in zip(in_shape, self.scale_factor)]
+        block_shape = [min(b, s) for b, s in
+                       zip(self.global_block_shape(), out_shape)]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=out_shape,
+                              chunks=block_shape, dtype=dtype)
+        block_list = self.blocks_in_volume(out_shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "scale_factor": self.scale_factor, "shape": out_shape,
+            "block_shape": block_shape, "in_shape": in_shape,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        factor = cfg["scale_factor"]
+        f_in = file_reader(cfg["input_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
+        sampler = cfg.get("sampler", "nearest")
+        dtype = ds_out.dtype
+
+        # interpolation needs a 1-voxel coarse halo so values at block faces
+        # see their neighbors — without it jax.image.resize edge-clamps at
+        # the window border and every block face shows a seam
+        pad = 1 if sampler == "interpolate" else 0
+        for block_id in job_config["block_list"]:
+            bb = blocking.get_block(block_id).bb
+            in_bb = tuple(
+                slice(max(b.start // f - pad, 0),
+                      min(-(-b.stop // f) + pad, s))
+                for b, f, s in zip(bb, factor, cfg["in_shape"]))
+            x = np.asarray(ds_in[in_bb])
+            if not x.any():
+                log_fn(f"processed block {block_id}")
+                continue
+            y = upsample(x, factor, sampler)
+            # crop the requested fine window out of the upsampled cover
+            off = [b.start - i.start * f
+                   for b, i, f in zip(bb, in_bb, factor)]
+            local = tuple(slice(o, o + (b.stop - b.start))
+                          for o, b in zip(off, bb))
+            y = y[local]
+            if np.dtype(dtype) in (np.dtype("uint8"), np.dtype("uint16")):
+                y = np.clip(np.round(y), 0, np.iinfo(dtype).max)
+            ds_out[bb] = y.astype(dtype)
+            log_fn(f"processed block {block_id}")
+
+
+def _normalize01(x: np.ndarray) -> np.ndarray:
+    x = x.astype("float32")
+    lo, hi = float(x.min()), float(x.max())
+    return (x - lo) / (hi - lo) if hi > lo else np.zeros_like(x)
+
+
+def _preserving_erosion(mask: np.ndarray, erode_by: int) -> np.ndarray:
+    """Erode, halving the radius until a nonempty seed survives
+    (reference: utils/volume_utils.py preserving_erosion)."""
+    from scipy.ndimage import binary_erosion
+
+    eroded = binary_erosion(mask, iterations=erode_by)
+    while not eroded.any():
+        if erode_by == 1:
+            return mask
+        erode_by //= 2
+        eroded = binary_erosion(mask, iterations=erode_by)
+    return eroded
+
+
+def fit_to_hmap(objs: np.ndarray, hmap: np.ndarray, erode_by,
+                fit_3d: bool = True) -> np.ndarray:
+    """Re-fit object boundaries to a height map: erode objects/background
+    into seeds, then grow them back with a seeded watershed over the height
+    map blended with a boundary distance (reference:
+    utils/volume_utils.py:294-391 ``fit_to_hmap``/``fit_seeds``).  The
+    erosion/seed logic stays on host (few objects, control-plane); the EDT
+    and the watershed flood run as device programs."""
+    import jax.numpy as jnp
+    from scipy.ndimage import binary_erosion
+
+    from ..ops.edt import distance_transform_edt
+    from ..ops.watershed import seeded_watershed
+
+    obj_ids = np.unique(objs)
+    obj_ids = obj_ids[obj_ids != 0]
+    bg_id = int(obj_ids[-1]) + 1 if len(obj_ids) else 1
+    if isinstance(erode_by, dict):
+        erode_by = {int(k): v for k, v in erode_by.items()}
+        max_erode = max(erode_by.values())
+    else:
+        max_erode = erode_by
+
+    def _seeds(objs2d_or_3d):
+        seeds = bg_id * binary_erosion(objs2d_or_3d == 0,
+                                       iterations=max_erode)
+        seeds = seeds.astype("uint32")
+        for obj_id in obj_ids:
+            obj_mask = objs2d_or_3d == obj_id
+            if not obj_mask.any():
+                continue
+            er = erode_by[obj_id] if isinstance(erode_by, dict) else erode_by
+            seeds[_preserving_erosion(obj_mask, er)] = obj_id
+        return seeds
+
+    hmap = _normalize01(hmap)
+    threshd = hmap > 0.3
+    alpha = 0.8
+
+    def _height(hm, th):
+        # distance of every voxel to the thresholded boundary set
+        dt = np.asarray(distance_transform_edt(jnp.asarray(~th)))
+        return alpha * hm + (1.0 - alpha) * (1.0 - _normalize01(dt))
+
+    if fit_3d:
+        seeds = _seeds(objs)
+        height = _height(hmap, threshd)
+        new = np.asarray(seeded_watershed(jnp.asarray(height),
+                                          jnp.asarray(seeds)))
+    else:
+        new = np.zeros(objs.shape, "uint32")
+        for z in range(objs.shape[0]):
+            seeds = _seeds(objs[z])
+            height = _height(hmap[z], threshd[z])
+            new[z] = np.asarray(seeded_watershed(jnp.asarray(height),
+                                                 jnp.asarray(seeds)))
+    new = new.astype("uint64")
+    new[new == bg_id] = 0
+    return new
+
+
+class ScaleToBoundariesTask(BlockTask):
+    """Fit (possibly low-resolution) objects to a full-resolution boundary
+    map (reference: downscaling/scale_to_boundaries.py:148-182
+    ``_scale_block`` / ``scale_to_boundaries``).
+
+    Blocks load the objects through an interpolated full-res view with an
+    ``erode_by`` halo, re-fit them to the boundary map via
+    :func:`fit_to_hmap`, add ``offset`` to the foreground, and ACCUMULATE
+    into the output (``out[fg] += obj[fg]``) so several object sets can be
+    painted with disjoint offset ranges, like the reference."""
+
+    task_name = "scale_to_boundaries"
+    allow_retry = False  # read-modify-write accumulate is not idempotent
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, boundaries_path: str, boundaries_key: str,
+                 offset: int = 0, **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.boundaries_path = boundaries_path
+        self.boundaries_key = boundaries_key
+        self.offset = int(offset)
+        self.identifier = f"offset{offset}"
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"erode_by": 12, "erode_3d": True, "channel": 0,
+                     "dtype": "uint64"})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.boundaries_path, "r") as f:
+            shape = list(f[self.boundaries_key].shape)
+        if len(shape) == 4:
+            shape = shape[1:]
+        block_shape = [min(b, s) for b, s in
+                       zip(self.global_block_shape(), shape)]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=shape,
+                              chunks=block_shape,
+                              dtype=self.task_config.get("dtype", "uint64"))
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "boundaries_path": self.boundaries_path,
+            "boundaries_key": self.boundaries_key, "offset": self.offset,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from ..core.volume_views import InterpolatedVolume
+
+        cfg = job_config["config"]
+        shape = cfg["shape"]
+        blocking = Blocking(shape, cfg["block_shape"])
+        erode_by = cfg.get("erode_by", 12)
+        erode_3d = bool(cfg.get("erode_3d", True))
+        channel = int(cfg.get("channel", 0))
+        offset = int(cfg["offset"])
+        halo_r = (max(erode_by.values()) if isinstance(erode_by, dict)
+                  else int(erode_by))
+        halo = [halo_r] * 3 if erode_3d else [0, halo_r, halo_r]
+
+        f_in = file_reader(cfg["input_path"], "r")
+        f_bd = file_reader(cfg["boundaries_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_bd = f_bd[cfg["boundaries_key"]]
+        ds_out = f_out[cfg["output_key"]]
+        ds_in = f_in[cfg["input_key"]]
+        if tuple(ds_in.shape) != tuple(shape):
+            ds_in = InterpolatedVolume(ds_in, shape)
+
+        for block_id in job_config["block_list"]:
+            block = blocking.get_block_with_halo(block_id, halo)
+            in_bb, out_bb = block.outer.bb, block.inner.bb
+            local_bb = block.inner_local.bb
+            obj = np.asarray(ds_in[in_bb])
+            if not obj.any():
+                log_fn(f"processed block {block_id}")
+                continue
+            if int(obj.max()) >= 2 ** 31:
+                raise ValueError(
+                    "scale_to_boundaries seeds are 32-bit (as in the "
+                    "reference's fit_seeds); relabel object ids below "
+                    "2**31 first")
+            if ds_bd.ndim == 4:
+                hmap = np.asarray(ds_bd[(slice(channel, channel + 1),)
+                                        + in_bb])[0]
+            else:
+                hmap = np.asarray(ds_bd[in_bb])
+            fitted = fit_to_hmap(obj, hmap, erode_by, fit_3d=erode_3d)
+            fitted = fitted[local_bb]
+            fg = fitted != 0
+            out = np.asarray(ds_out[out_bb])
+            out[fg] += (fitted[fg] + offset).astype(out.dtype)
+            ds_out[out_bb] = out
+            log_fn(f"processed block {block_id}")
+
+
+class PainteraToBdvWorkflow(Task):
+    """Convert a Paintera multiscale group to a BigDataViewer (bdv.n5)
+    pyramid (reference: downscaling_workflow.py:352+ ``PainteraToBdvWorkflow``).
+
+    Discovers the ``s0..sN`` scale levels under ``input_key_prefix``, copies
+    each to the bdv.n5 layout ``setup0/timepoint0/s{i}`` with CopyVolume
+    tasks, and writes the bdv metadata + SpimData XML sidecar.  Resolution /
+    offset attributes found on the paintera group are carried over
+    (java-order XYZ -> ZYX).  Output stays n5 — the reference itself notes
+    "HDF5 is frickin slow" and computes in n5; our bdv export is the bdv.n5
+    flavor rather than the legacy bdv.h5 one.
+
+    Like the reference, ``requires()`` inspects the paintera group at
+    DAG-construction time (reference: downscaling_workflow.py get_scales),
+    so the group must already exist when this workflow is constructed —
+    build upstream producers in a separate ``build()`` first; ``dependency``
+    only sequences tasks that do not create the group."""
+
+    def __init__(self, input_path: str, input_key_prefix: str,
+                 output_path: str, tmp_folder: str, config_dir: str,
+                 max_jobs: int = 1, target: str = "local",
+                 dtype: Optional[str] = None, metadata_dict=None,
+                 dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key_prefix = input_key_prefix
+        self.output_path = output_path
+        self.dtype = dtype
+        self.metadata_dict = dict(metadata_dict or {})
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def _scales(self) -> List[int]:
+        root = os.path.join(self.input_path, self.input_key_prefix)
+        scales = []
+        for name in os.listdir(root):
+            if not name.startswith("s"):
+                continue
+            if not os.path.isdir(os.path.join(root, name)):
+                continue
+            try:
+                scales.append(int(name[1:]))
+            except ValueError:
+                pass
+        return sorted(scales)
+
+    def requires(self):
+        from .copy_volume import CopyVolumeTask
+
+        common = dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                      max_jobs=self.max_jobs, target=self.target)
+        scales = self._scales()
+        dep = self.dependency
+        prev = None
+        rel_factors = []  # ZYX, per scale > 0
+        with file_reader(self.input_path, "r") as f:
+            for scale in scales:
+                in_key = os.path.join(self.input_key_prefix, f"s{scale}")
+                eff = f[in_key].attrs.get("downsamplingFactors", [1, 1, 1])
+                eff = [eff] * 3 if isinstance(eff, (int, float)) else list(eff)
+                if scale > 0:
+                    rel = [int(round(e / p)) for e, p in zip(eff, prev)]
+                    if any(r < 1 for r in rel):
+                        raise ValueError(
+                            f"scale s{scale} downsamplingFactors {eff} not "
+                            f"monotone over previous {prev} — missing or "
+                            "inconsistent paintera attributes")
+                    rel_factors.append(rel[::-1])
+                prev = list(eff)
+            attrs = f[self.input_key_prefix].attrs
+            offsets = attrs.get("offset")
+            resolution = attrs.get("resolution")
+        meta = dict(self.metadata_dict)
+        if "offsets" not in meta and offsets is not None:
+            meta["offsets"] = list(offsets)[::-1]
+        if "resolution" not in meta and resolution is not None:
+            meta["resolution"] = list(resolution)[::-1]
+
+        for scale in scales:
+            dep = CopyVolumeTask(
+                input_path=self.input_path,
+                input_key=os.path.join(self.input_key_prefix, f"s{scale}"),
+                output_path=self.output_path,
+                output_key=f"setup0/timepoint0/s{scale}",
+                dtype=self.dtype, identifier=f"bdv_s{scale}",
+                dependency=dep, **common)
+        return WriteDownscalingMetadata(
+            tmp_folder=self.tmp_folder, output_path=self.output_path,
+            scale_factors=rel_factors,
+            output_key_prefix="setup0/timepoint0",
+            metadata_dict=meta, metadata_format="bdv",
+            identifier="paintera_to_bdv", dependency=dep)
+
+    def output(self):
+        return FileTarget(os.path.join(
+            self.tmp_folder, "downscaling_metadata_paintera_to_bdv.status"))
+
+
 class DownscaleTask(BlockTask):
     """One pyramid level: blockwise downsample of the previous level
     (reference: DownscalingBase, downscaling.py:31-140)."""
@@ -213,8 +586,9 @@ class WriteDownscalingMetadata(Task):
     def __init__(self, tmp_folder: str, output_path: str, scale_factors,
                  output_key_prefix: str = "", metadata_dict=None,
                  scale_offset: int = 0, metadata_format: str = "paintera",
-                 dependency: Optional[Task] = None):
+                 identifier: str = "", dependency: Optional[Task] = None):
         assert metadata_format in ("paintera", "bdv"), metadata_format
+        self.identifier = identifier
         # the bdv factor list and XML size are absolute (relative to s0);
         # with an offset the factors below it are unknown to this task
         if metadata_format == "bdv" and scale_offset != 0:
@@ -313,8 +687,9 @@ class WriteDownscalingMetadata(Task):
         self.output().touch()
 
     def output(self):
-        return FileTarget(os.path.join(self.tmp_folder,
-                                       "downscaling_metadata.status"))
+        suffix = f"_{self.identifier}" if self.identifier else ""
+        return FileTarget(os.path.join(
+            self.tmp_folder, f"downscaling_metadata{suffix}.status"))
 
 
 class DownscalingWorkflow(Task):

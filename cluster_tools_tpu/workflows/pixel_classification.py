@@ -313,8 +313,13 @@ class WriteCarving(Task):
     The graph dataset follows the serialization the reference targets
     (vigra adjacencyListGraph): a flat uint32 array
     ``[n_nodes, n_edges, max_node_id, max_edge_id] + uv_ids.ravel() +
-    neighborhoods``, where ``neighborhoods`` lists, per node id in order,
-    its degree followed by (neighbor_id, edge_id) pairs sorted by neighbor.
+    neighborhoods``.  ``n_nodes`` counts the DISTINCT node ids present
+    (the vigra convention — smaller than ``max_node_id + 1`` when ids are
+    non-consecutive), while ``neighborhoods`` is POSITIONAL over all
+    ``max_node_id + 1`` ids in order — isolated ids contribute a degree-0
+    record; readers must size the section from ``max_node_id``, not
+    ``n_nodes``.  Each record is the node's degree followed by
+    (neighbor_id, edge_id) pairs sorted by neighbor.
     Edge weights are the mean-probability feature column rescaled to the
     carving convention's 0-255 range (reference: carving.py:57-69)."""
 
@@ -342,10 +347,17 @@ class WriteCarving(Task):
     @staticmethod
     def serialize_graph(uv_ids: np.ndarray,
                         max_node_id: int) -> np.ndarray:
-        """Flat uint32 serialization (header + uv ids + neighborhoods)."""
+        """Flat uint32 serialization (header + uv ids + neighborhoods).
+
+        The header matches the vigra adjacencyListGraph convention: n_nodes
+        is the number of DISTINCT node ids present (not max_node_id + 1 —
+        they differ for non-consecutive ids), and an empty graph's
+        max_edge_id is -1, which wraps to 0xFFFFFFFF in uint32."""
         n_edges = len(uv_ids)
-        header = np.array([max_node_id + 1, n_edges,
-                           max_node_id, max(n_edges - 1, 0)], "uint32")
+        n_nodes = len(np.unique(uv_ids)) if n_edges else 0
+        header = np.array([n_nodes, n_edges,
+                           max_node_id, n_edges - 1],
+                          "int64").astype("uint32")
         # per-node adjacency: degree, then (neighbor, edge_id) by neighbor
         adj = [[] for _ in range(max_node_id + 1)]
         for eid, (u, v) in enumerate(uv_ids):

@@ -319,3 +319,117 @@ def test_label_multiset_workflow(tmp_workdir, tmp_path):
     bs = src.attrs["blockShape"]
     fine_win = labels[:bs[0] * 2, :bs[1] * 2, :bs[2] * 2]
     np.testing.assert_array_equal(got_u, np.unique(fine_win))
+
+
+def test_upscale_task(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.downscaling import UpscaleTask
+
+    tmp_folder, config_dir = tmp_workdir
+    coarse = np.random.RandomState(0).randint(
+        0, 9, size=(8, 8, 8)).astype("uint64")
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("coarse", data=coarse, chunks=[8, 8, 8])
+
+    task = UpscaleTask(
+        input_path=path, input_key="coarse", output_path=path,
+        output_key="fine", scale_factor=[2, 2, 2],
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    assert build([task], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        fine = f["fine"][:]
+    expected = np.repeat(np.repeat(np.repeat(coarse, 2, 0), 2, 1), 2, 2)
+    np.testing.assert_array_equal(fine, expected)
+
+    # interpolating upscale of a float volume: smooth, right shape/range
+    vol = np.random.RandomState(1).rand(8, 8, 8).astype("float32")
+    with file_reader(path) as f:
+        f.create_dataset("volf", data=vol, chunks=[8, 8, 8])
+    from cluster_tools_tpu.core.config import ConfigDir
+    ConfigDir(config_dir).write_task_config(
+        "upscaling", {"sampler": "interpolate"})
+    task = UpscaleTask(
+        input_path=path, input_key="volf", output_path=path,
+        output_key="finef", scale_factor=[1, 2, 2], identifier="interp",
+        tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([task], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        finef = f["finef"][:]
+    assert finef.shape == (8, 16, 16)
+    assert finef.min() >= vol.min() - 1e-5
+    assert finef.max() <= vol.max() + 1e-5
+
+
+def test_scale_to_boundaries(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.downscaling import ScaleToBoundariesTask
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (16, 16, 16)
+    # coarse objects (half resolution): object 5 fills x < 3 -> full-res x < 6
+    objs_lr = np.zeros((8, 8, 8), "uint64")
+    objs_lr[:, :, :3] = 5
+    # boundary map: the TRUE boundary is the ridge at x = 9
+    bnd = np.zeros(shape, "float32")
+    bnd[:, :, 8:11] = 1.0
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        f.create_dataset("objs", data=objs_lr, chunks=[8, 8, 8])
+        f.create_dataset("bnd", data=bnd, chunks=[16, 16, 16])
+
+    from cluster_tools_tpu.core.config import ConfigDir
+    ConfigDir(config_dir).write_task_config(
+        "scale_to_boundaries", {"erode_by": 2})
+    task = ScaleToBoundariesTask(
+        input_path=path, input_key="objs", output_path=path,
+        output_key="fitted", boundaries_path=path, boundaries_key="bnd",
+        offset=100, tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=1, target="threads")
+    assert build([task], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        fitted = f["fitted"][:]
+    # ids preserved (+offset), background stays 0
+    assert set(np.unique(fitted).tolist()) <= {0, 105}
+    # the object grew from its coarse extent (x<6) toward the ridge, and
+    # did not leak past it
+    inner = fitted[4:12, 4:12, :]
+    assert (inner[:, :, :7] == 105).mean() > 0.9
+    assert (inner[:, :, 11:] == 0).all()
+
+
+def test_paintera_to_bdv(tmp_workdir, tmp_path):
+    from cluster_tools_tpu.workflows.downscaling import PainteraToBdvWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    vol = np.random.RandomState(0).randint(
+        0, 100, size=(8, 16, 16)).astype("uint64")
+    path = str(tmp_path / "paintera.n5")
+    out_path = str(tmp_path / "bdv.n5")
+    with file_reader(path) as f:
+        f.create_dataset("seg/data/s0", data=vol, chunks=[8, 8, 8])
+        s1 = vol[:, ::2, ::2]
+        f.create_dataset("seg/data/s1", data=s1, chunks=[8, 8, 8])
+        f["seg/data/s1"].attrs["downsamplingFactors"] = [2, 2, 1]  # XYZ
+        g = f.require_group("seg/data")
+        g.attrs["resolution"] = [4.0, 4.0, 40.0]  # XYZ
+        g.attrs["offset"] = [0.0, 0.0, 0.0]
+
+    wf = PainteraToBdvWorkflow(
+        input_path=path, input_key_prefix="seg/data", output_path=out_path,
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=2,
+        target="threads")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(out_path, "r") as f:
+        np.testing.assert_array_equal(f["setup0/timepoint0/s0"][:], vol)
+        np.testing.assert_array_equal(f["setup0/timepoint0/s1"][:], s1)
+        setup_attrs = dict(f["setup0"].attrs)
+    assert setup_attrs["downsamplingFactors"] == [[1, 1, 1], [2, 2, 1]]
+    assert setup_attrs["dataType"] == "uint64"
+    # SpimData XML sidecar with the carried-over ZYX->XYZ resolution
+    xml_path = str(tmp_path / "bdv.xml")
+    assert os.path.exists(xml_path)
+    with open(xml_path) as f:
+        xml = f.read()
+    assert "4.0 4.0 40.0" in xml
