@@ -263,6 +263,11 @@ EXECUTORS = {
     "local": _LocalExecutor,
     "inline": _InlineExecutor,
     "tpu": _InlineExecutor,
+    # the `mesh` target runs mesh-aware tasks as SPMD programs over a
+    # jax.sharding.Mesh (one block per device, workflows/mesh_blockwise.py);
+    # tasks without a mesh formulation fall back to the inline executor in
+    # the driver process, which owns the mesh
+    "mesh": _InlineExecutor,
     "threads": _ThreadExecutor,
 }
 
@@ -420,6 +425,7 @@ class BlockTask(Task):
                 "tmp_folder": self.tmp_folder,
                 "config_dir": self.config_dir,
                 "task_name": self.name_with_id,
+                "target": self.target,
                 "src_file": src_file,
                 "global_config": self.global_config,
                 "config": {**self.task_config, **task_specific_config},

@@ -96,6 +96,17 @@ def param_sharding(mesh: Mesh, params) -> Dict:
     return jax.tree_util.tree_map(leaf_spec, params)
 
 
+def blocks_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh with the ``blocks`` axis — the layout of the ``mesh``
+    execution target: a batch of outer volume blocks is sharded one block
+    per device and the blockwise kernels run as one SPMD program (the
+    TPU-native replacement for the reference's one-job-per-block fan-out,
+    cluster_tasks.py:447-490)."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(np.array(devices[:n]), ("blocks",))
+
+
 def single_axis_mesh(axis: str, n_shards: int,
                      n_devices: Optional[int] = None) -> Mesh:
     """Mesh with one named axis spanning the first ``n_shards`` devices

@@ -162,11 +162,25 @@ class BlockEdgeFeatures(BlockTask):
                 raw = np.asarray(ds_in[(slice(0, len(offsets)),) + bb])
             return block_id, block, begin, end, obegin, labels, raw, data
 
+        host_impl = cfg.get("impl") == "host"
+
         def submit(entry):
             block_id, block, begin, end, obegin, labels, raw, data = entry
             edges, edge_ids = data["edges"], data["edge_ids"]
             if len(edges) == 0 and offsets is None:
                 return block_id, None, None, None, None
+            if host_impl:
+                # reference-faithful CPU path: numpy pair extraction +
+                # sort-based segmented stats, no device involvement
+                if responses or offsets is not None:
+                    raise ValueError("impl='host' supports plain boundary "
+                                     "features only")
+                from ..ops.rag import host_boundary_edge_features
+
+                uv, feats = host_boundary_edge_features(
+                    labels, raw.astype("float32") / scale,
+                    inner_shape=tuple(block.shape))
+                return block_id, ("host", uv, feats), edges, edge_ids, "host"
             lut, dense = densify_labels(labels)
             if responses:
                 # filter-bank features: one device filter response per
@@ -192,9 +206,11 @@ class BlockEdgeFeatures(BlockTask):
                     lambda m: boundary_pair_values(
                         dense_dev, m, inner_shape=tuple(block.shape)),
                     out_axes=(None, None, 0, None))(resp_stack)
-                handles = [device_edge_stats_submit(u, v, vals[k], ok,
-                                                    e_max=e_max)
-                           for k in range(len(responses))]
+                from ..ops.rag import device_edge_stats_submit_multi
+
+                handles = device_edge_stats_submit_multi(
+                    u, v, ok, [vals[k] for k in range(len(responses))],
+                    e_max=e_max)
             elif offsets is None:
                 bmap = raw.astype("float32") / scale
                 u, v, val, ok = boundary_pair_values(
@@ -224,18 +240,21 @@ class BlockEdgeFeatures(BlockTask):
                          features=np.zeros((0, n_feats), "float64"))
                 log_fn(f"processed block {block_id}")
                 return
-            groups = []
-            for h in handles:
-                uv_dense, ef = device_edge_stats_finalize(h, e_max)
-                groups.append(ef)
-            if responses:
-                edge_feats = np.concatenate(
-                    [f[:, :9] for f in groups] + [groups[-1][:, 9:10]],
-                    axis=1)
+            if handles == "host":
+                _, uv, edge_feats = lut
             else:
-                edge_feats = groups[0]
-            uv = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]],
-                          axis=1)
+                groups = []
+                for h in handles:
+                    uv_dense, ef = device_edge_stats_finalize(h, e_max)
+                    groups.append(ef)
+                if responses:
+                    edge_feats = np.concatenate(
+                        [f[:, :9] for f in groups] + [groups[-1][:, 9:10]],
+                        axis=1)
+                else:
+                    edge_feats = groups[0]
+                uv = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]],
+                              axis=1)
             if offsets is None:
                 # boundary faces share the RAG's ownership rule, so every
                 # edge maps into the block's own sub-graph

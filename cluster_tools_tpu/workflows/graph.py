@@ -80,8 +80,22 @@ class InitialSubGraphs(BlockTask):
             bb = tuple(slice(b, e) for b, e in zip(block.begin, end))
             return block_id, block, np.asarray(ds[bb])
 
+        host_impl = cfg.get("impl") == "host"
+
         def submit(entry):
             block_id, block, labels = entry
+            if host_impl:
+                # reference-faithful CPU path: numpy slicing + unique (the
+                # shape of the reference's ndist C++ block extraction)
+                from ..ops.rag import host_label_pairs
+
+                uniq = np.unique(labels)
+                zero_present = bool(len(uniq) and uniq[0] == 0)
+                nodes = (uniq if (zero_present and not ignore_label)
+                         else uniq[uniq != 0])
+                edges = host_label_pairs(labels, ignore_label,
+                                         tuple(block.shape))
+                return block_id, nodes, None, edges.astype("uint64")
             lut, dense = densify_labels(labels)
             # nodes straight from the densification LUT (sorted uniques
             # with 0 prepended) — no second full-block unique, and the
@@ -99,9 +113,12 @@ class InitialSubGraphs(BlockTask):
 
         def drain(entry):
             block_id, nodes, lut, handles = entry
-            uv_dense, _ = device_edge_stats_finalize(handles, e_max)
-            edges = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]],
-                             axis=1).astype("uint64")
+            if host_impl:
+                edges = handles
+            else:
+                uv_dense, _ = device_edge_stats_finalize(handles, e_max)
+                edges = np.stack([lut[uv_dense[:, 0]], lut[uv_dense[:, 1]]],
+                                 axis=1).astype("uint64")
             g.save_sub_graph(cfg["graph_path"], 0, block_id,
                              nodes.astype("uint64"), edges)
             log_fn(f"processed block {block_id}")

@@ -193,10 +193,14 @@ class BlockFaces(BlockTask):
 
     task_name = "block_faces"
 
-    def __init__(self, path: str, key: str, offsets_path: str, **kw):
+    def __init__(self, path: str, key: str, offsets_path: str,
+                 skip_covered: bool = False, **kw):
         self.path = path
         self.key = key
         self.offsets_path = offsets_path
+        #: skip faces the mesh phase already merged on device (their block
+        #: pairs are listed as ``covered_faces`` in the offsets JSON)
+        self.skip_covered = skip_covered
         super().__init__(**kw)
 
     def run_impl(self):
@@ -207,6 +211,7 @@ class BlockFaces(BlockTask):
         self.run_jobs(block_list, {
             "path": self.path, "key": self.key,
             "offsets_path": self.offsets_path,
+            "skip_covered": self.skip_covered,
             "shape": shape, "block_shape": block_shape,
         }, n_jobs=self.max_jobs)
 
@@ -215,13 +220,18 @@ class BlockFaces(BlockTask):
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
         with open(cfg["offsets_path"]) as f:
-            offsets = np.asarray(json.load(f)["offsets"], dtype="uint64")
+            off_data = json.load(f)
+        offsets = np.asarray(off_data["offsets"], dtype="uint64")
+        covered = (set(map(tuple, off_data.get("covered_faces", [])))
+                   if cfg.get("skip_covered") else set())
         ndim = blocking.ndim
         f = file_reader(cfg["path"], "r")
         ds = f[cfg["key"]]
         pairs: List[np.ndarray] = []
         for block_id in job_config["block_list"]:
             for face in iterate_faces(blocking, block_id, halo=[1] * ndim):
+                if (face.block_a, face.block_b) in covered:
+                    continue
                 region = ds[face.outer_bb]
                 la = region[face.face_a].ravel().astype("uint64")
                 lb = region[face.face_b].ravel().astype("uint64")
@@ -335,17 +345,35 @@ class ThresholdedComponentsWorkflow(Task):
         block_shape = ConfigDir(self.config_dir).global_config()["block_shape"]
         n_blocks = Blocking(shape, block_shape[-len(shape):]).n_blocks
 
-        t1 = BlockComponents(
-            input_path=self.input_path, input_key=self.input_key,
-            output_path=self.output_path, output_key=self.output_key,
-            threshold=self.threshold, threshold_mode=self.threshold_mode,
-            mask_path=self.mask_path, mask_key=self.mask_key,
-            dependency=self.dependency, **self._common())
-        t2 = MergeOffsets(n_blocks=n_blocks, offsets_path=offsets_path,
-                          dependency=t1, **self._common())
-        t3 = BlockFaces(path=self.output_path, key=self.output_key,
-                        offsets_path=offsets_path, dependency=t2,
-                        **self._common())
+        if self.target == "mesh" and not self.mask_path:
+            # SPMD phase: per-block CC + on-device offset scan + ICI face
+            # exchange in one program per round (workflows/mesh_blockwise);
+            # the remaining (other-axis / round-boundary) faces go through
+            # the host scan with the device-covered pairs skipped
+            from .mesh_blockwise import MeshBlockComponents
+
+            t2 = MeshBlockComponents(
+                input_path=self.input_path, input_key=self.input_key,
+                output_path=self.output_path, output_key=self.output_key,
+                threshold=self.threshold,
+                threshold_mode=self.threshold_mode,
+                offsets_path=offsets_path,
+                dependency=self.dependency, **self._common())
+            t3 = BlockFaces(path=self.output_path, key=self.output_key,
+                            offsets_path=offsets_path, skip_covered=True,
+                            dependency=t2, **self._common())
+        else:
+            t1 = BlockComponents(
+                input_path=self.input_path, input_key=self.input_key,
+                output_path=self.output_path, output_key=self.output_key,
+                threshold=self.threshold, threshold_mode=self.threshold_mode,
+                mask_path=self.mask_path, mask_key=self.mask_key,
+                dependency=self.dependency, **self._common())
+            t2 = MergeOffsets(n_blocks=n_blocks, offsets_path=offsets_path,
+                              dependency=t1, **self._common())
+            t3 = BlockFaces(path=self.output_path, key=self.output_key,
+                            offsets_path=offsets_path, dependency=t2,
+                            **self._common())
         t4 = MergeAssignments(offsets_path=offsets_path,
                               assignment_path=assignment_path,
                               dependency=t3, **self._common())

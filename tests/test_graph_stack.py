@@ -47,12 +47,17 @@ def graph_setup(tmp_path, tmp_workdir):
     return labels, path, tmp_folder, config_dir
 
 
-def test_graph_workflow_matches_bruteforce(graph_setup, tmp_path):
+@pytest.mark.parametrize("impl", ["device", "host"])
+def test_graph_workflow_matches_bruteforce(graph_setup, tmp_path, impl):
     import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.config import ConfigDir
     from cluster_tools_tpu.core.graph import load_graph, load_sub_graph
     from cluster_tools_tpu.workflows.graph import GraphWorkflow
 
     labels, path, tmp_folder, config_dir = graph_setup
+    if impl == "host":
+        ConfigDir(config_dir).write_task_config("initial_sub_graphs",
+                                                {"impl": "host"})
     graph_path = str(tmp_path / "graph.n5")
     wf = GraphWorkflow(input_path=path, input_key="labels",
                        graph_path=graph_path, tmp_folder=tmp_folder,
@@ -69,14 +74,19 @@ def test_graph_workflow_matches_bruteforce(graph_setup, tmp_path):
     np.testing.assert_array_equal(edges[sub["edge_ids"]], sub["edges"])
 
 
-def test_edge_features_match_bruteforce(graph_setup, tmp_path):
+@pytest.mark.parametrize("impl", ["device", "host"])
+def test_edge_features_match_bruteforce(graph_setup, tmp_path, impl):
     import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.config import ConfigDir
     from cluster_tools_tpu.core.graph import load_graph
     from cluster_tools_tpu.core.storage import file_reader
     from cluster_tools_tpu.workflows.features import EdgeFeaturesWorkflow
     from cluster_tools_tpu.workflows.graph import GraphWorkflow
 
     labels, path, tmp_folder, config_dir = graph_setup
+    if impl == "host":
+        ConfigDir(config_dir).write_task_config("block_edge_features",
+                                                {"impl": "host"})
     rng = np.random.RandomState(1)
     bmap = rng.rand(*labels.shape).astype("float32")
     _write_volume(path, "boundaries", bmap, (10, 10, 10))

@@ -1,0 +1,106 @@
+"""Mesh execution target: blockwise workflows as SPMD programs over the
+virtual 8-device CPU mesh, bit-identical to the per-block targets."""
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.core.storage import file_reader
+from cluster_tools_tpu.core.workflow import build
+
+
+def _blob_volume(shape, seed=0):
+    """Jittered-grid gaussian blobs: many well-separated components."""
+    rng = np.random.RandomState(seed)
+    vol = np.zeros(shape, "float32")
+    zz, yy, xx = np.meshgrid(*[np.arange(s) for s in shape], indexing="ij")
+    step = 8
+    for gz in range(step // 2, shape[0], step):
+        for gy in range(step // 2, shape[1], step):
+            for gx in range(step // 2, shape[2], step):
+                c = np.array([gz, gy, gx]) + rng.rand(3) * 2 - 1
+                r = 1.2 + rng.rand()
+                d2 = ((zz - c[0]) ** 2 + (yy - c[1]) ** 2
+                      + (xx - c[2]) ** 2)
+                vol = np.maximum(vol, np.exp(-d2 / (2 * r * r)))
+    return vol
+
+
+@pytest.fixture()
+def cc_setup(tmp_path, tmp_workdir):
+    tmp_folder, config_dir = tmp_workdir
+    vol = _blob_volume((20, 30, 40))
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        ds = f.require_dataset("vol", shape=vol.shape, chunks=(10, 10, 10),
+                               dtype="float32")
+        ds[:] = vol
+    return vol, path, tmp_folder, config_dir
+
+
+def _run_cc(path, tmp_folder, config_dir, target, out_key):
+    from cluster_tools_tpu.workflows.thresholded_components import (
+        ThresholdedComponentsWorkflow)
+
+    wf = ThresholdedComponentsWorkflow(
+        input_path=path, input_key="vol", output_path=path,
+        output_key=out_key, threshold=0.35,
+        tmp_folder=f"{tmp_folder}_{target}_{out_key}",
+        config_dir=config_dir, max_jobs=2, target=target)
+    assert build([wf], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        return f[out_key][:]
+
+
+def test_mesh_cc_bit_identical_to_local(cc_setup):
+    vol, path, tmp_folder, config_dir = cc_setup
+    local = _run_cc(path, tmp_folder, config_dir, "local", "cc_local")
+    mesh = _run_cc(path, tmp_folder, config_dir, "mesh", "cc_mesh")
+    np.testing.assert_array_equal(mesh, local)
+    # sanity: a real segmentation came out
+    assert len(np.unique(local)) > 5
+
+
+def test_mesh_cc_covers_device_faces(cc_setup, tmp_path):
+    """The mesh phase must put a nonzero number of face merges on the
+    device path (ppermute over the mesh axis), not fall back to host for
+    everything."""
+    import json
+    import os
+
+    vol, path, tmp_folder, config_dir = cc_setup
+    _run_cc(path, tmp_folder, config_dir, "mesh", "cc_mesh2")
+    offsets_file = os.path.join(f"{tmp_folder}_mesh_cc_mesh2",
+                                "cc_offsets.json")
+    with open(offsets_file) as f:
+        meta = json.load(f)
+    assert len(meta["covered_faces"]) > 0
+    assert meta["n_labels"] > 5
+
+
+def test_mesh_watershed_matches_inline(tmp_path, tmp_workdir):
+    from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+    tmp_folder, config_dir = tmp_workdir
+    rng = np.random.RandomState(0)
+    from scipy import ndimage
+
+    vol = ndimage.gaussian_filter(
+        rng.rand(20, 30, 40).astype("float32"), 2.0)
+    vol = (vol - vol.min()) / (vol.max() - vol.min())
+    path = str(tmp_path / "w.n5")
+    with file_reader(path) as f:
+        ds = f.require_dataset("b", shape=vol.shape, chunks=(10, 10, 10),
+                               dtype="float32")
+        ds[:] = vol
+
+    segs = {}
+    for target, key in (("inline", "ws_inline"), ("mesh", "ws_mesh")):
+        wf = WatershedWorkflow(
+            input_path=path, input_key="b", output_path=path,
+            output_key=key, tmp_folder=f"{tmp_folder}_{target}",
+            config_dir=config_dir, max_jobs=2, target=target)
+        assert build([wf], raise_on_failure=True)
+        with file_reader(path, "r") as f:
+            segs[target] = f[key][:]
+    np.testing.assert_array_equal(segs["mesh"], segs["inline"])
+    assert (segs["inline"] > 0).all()

@@ -55,7 +55,8 @@ def test_gaussian_filters_match_scipy():
                   - ndimage.gaussian_laplace(x, 1.2, mode="reflect")).max() < 1e-2
 
 
-def test_seeded_watershed_properties():
+@pytest.mark.parametrize("method", ["basins", "flood"])
+def test_seeded_watershed_properties(method):
     from cluster_tools_tpu.ops.watershed import seeded_watershed
 
     # two basins split by a ridge
@@ -63,7 +64,8 @@ def test_seeded_watershed_properties():
     h[:, 14:16] = 1.0
     seeds = np.zeros((20, 30), "int32")
     seeds[10, 4], seeds[10, 25] = 1, 2
-    ws = np.asarray(seeded_watershed(jnp.asarray(h), jnp.asarray(seeds)))
+    ws = np.asarray(seeded_watershed(jnp.asarray(h), jnp.asarray(seeds),
+                                     method=method))
     assert (ws > 0).all()
     assert (ws[:, :14] == 1).all()
     assert (ws[:, 16:] == 2).all()
@@ -71,7 +73,8 @@ def test_seeded_watershed_properties():
     assert ws[10, 4] == 1 and ws[10, 25] == 2
 
 
-def test_seeded_watershed_respects_mask():
+@pytest.mark.parametrize("method", ["basins", "flood"])
+def test_seeded_watershed_respects_mask(method):
     from cluster_tools_tpu.ops.watershed import seeded_watershed
 
     h = np.random.RandomState(0).rand(16, 16).astype("float32")
@@ -80,9 +83,34 @@ def test_seeded_watershed_respects_mask():
     mask = np.ones((16, 16), bool)
     mask[:, 8:] = False
     ws = np.asarray(seeded_watershed(jnp.asarray(h), jnp.asarray(seeds),
-                                     jnp.asarray(mask)))
+                                     jnp.asarray(mask), method=method))
     assert (ws[:, 8:] == 0).all()
     assert (ws[:, :8] == 1).all()
+
+
+def test_basins_dense_seed_regrow_keeps_adjacent_labels():
+    # adjacent different-id seed clusters must NOT merge (the size-filter
+    # regrow passes dense kept fragments as seeds)
+    from cluster_tools_tpu.ops.watershed import seeded_watershed_basins
+
+    h = np.random.RandomState(1).rand(12, 12).astype("float32")
+    seeds = np.zeros((12, 12), "int32")
+    seeds[:, :6] = 3
+    seeds[:, 6:] = 7  # touching block of a different id
+    seeds[5, 5] = 0   # one free voxel to fill
+    ws = np.asarray(seeded_watershed_basins(jnp.asarray(h),
+                                            jnp.asarray(seeds)))
+    assert (ws[:, :5] == 3).all()
+    assert (ws[:, 6:] == 7).all()
+    assert ws[5, 5] in (3, 7)
+
+
+def test_seeded_watershed_unknown_method_raises():
+    from cluster_tools_tpu.ops.watershed import seeded_watershed
+
+    with pytest.raises(ValueError, match="unknown watershed method"):
+        seeded_watershed(jnp.zeros((4, 4)), jnp.zeros((4, 4), "int32"),
+                         method="basin")
 
 
 @pytest.mark.parametrize("target", ["inline"])
@@ -360,3 +388,27 @@ def test_edt_axes_and_vmap_safety():
     cost = (idx[:, None] - idx[None, :]) ** 2
     want = (f[:, :, None, :] + cost[None, None]).min(-1)
     np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_host_watershed_block_quality():
+    """run_ws_block_host (scipy reference-faithful path) segments the
+    synthetic boundary volume comparably to the device path."""
+    from cluster_tools_tpu.workflows.watershed import (run_ws_block,
+                                                       run_ws_block_host)
+
+    vol = _boundary_volume((24, 24, 24), n_cells=6)
+    cfg = {"threshold": 0.4, "sigma_seeds": 1.5, "sigma_weights": 1.5,
+           "size_filter": 10, "alpha": 0.8}
+    host = run_ws_block_host(vol, cfg)
+    dev = run_ws_block(vol, cfg)
+    assert host.shape == vol.shape
+    # both produce a dense fragmentation of comparable granularity
+    n_host = len(np.unique(host[host > 0]))
+    n_dev = len(np.unique(dev[dev > 0]))
+    assert n_host >= 2 and n_dev >= 2
+    assert n_host < 8 * n_dev and n_dev < 8 * n_host
+    # host fragments respect the mask argument
+    mask = np.ones(vol.shape, bool)
+    mask[:, :, 12:] = False
+    host_m = run_ws_block_host(vol, cfg, mask=mask)
+    assert (host_m[:, :, 12:] == 0).all()
