@@ -116,8 +116,14 @@ def run_chain(store_path, shape, workdir, target, host_impl=False,
     cfg = ConfigDir(config_dir)
     cfg.write_global_config({"block_shape": BLOCK})
     impl = {"impl": "host"} if host_impl else {}
-    cfg.write_task_config("watershed",
-                          {"threshold": 0.4, "size_filter": 50, **impl})
+    ws_params = {"threshold": 0.4, "size_filter": 50}
+    cfg.write_task_config("watershed", {**ws_params, **impl})
+    # hybrid: device runs EDT/filters/seeds/feature-stats, the host C++
+    # bucket-queue flood handles the (gather-bound, serial-friendly)
+    # priority flood — same flood algorithm as the CPU baseline, so the
+    # device<->CPU quality delta stays tight
+    cfg.write_task_config("fused_segmentation",
+                          {**ws_params, "ws_method": "hybrid"})
     cfg.write_task_config("initial_sub_graphs", impl)
     cfg.write_task_config("block_edge_features", impl)
     if max_jobs is None:
@@ -130,17 +136,28 @@ def run_chain(store_path, shape, workdir, target, host_impl=False,
             max_jobs = min(max_jobs, n_blocks)
 
     t0 = time.perf_counter()
-    ws = WatershedWorkflow(
-        input_path=store_path, input_key="bmap", output_path=store_path,
-        output_key="ws", tmp_folder=os.path.join(workdir, "tmp"),
-        config_dir=config_dir, max_jobs=max_jobs, target=target)
-    mc = ctt.MulticutSegmentationWorkflow(
-        input_path=store_path, input_key="bmap", ws_path=store_path,
-        ws_key="ws", problem_path=os.path.join(workdir, "p.n5"),
-        output_path=store_path, output_key="seg",
-        tmp_folder=os.path.join(workdir, "tmp"),
-        config_dir=config_dir, max_jobs=max_jobs, target=target,
-        n_scales=1, dependency=ws)
+    if target == "tpu":
+        # fused device chain: ws + relabel + RAG + features in one device
+        # program per block (workflows/fused_pipeline.py)
+        mc = ctt.MulticutSegmentationWorkflow(
+            input_path=store_path, input_key="bmap", ws_path=store_path,
+            ws_key="ws", problem_path=os.path.join(workdir, "p.n5"),
+            output_path=store_path, output_key="seg",
+            tmp_folder=os.path.join(workdir, "tmp"),
+            config_dir=config_dir, max_jobs=max_jobs, target=target,
+            n_scales=1, fused=True)
+    else:
+        ws = WatershedWorkflow(
+            input_path=store_path, input_key="bmap", output_path=store_path,
+            output_key="ws", tmp_folder=os.path.join(workdir, "tmp"),
+            config_dir=config_dir, max_jobs=max_jobs, target=target)
+        mc = ctt.MulticutSegmentationWorkflow(
+            input_path=store_path, input_key="bmap", ws_path=store_path,
+            ws_key="ws", problem_path=os.path.join(workdir, "p.n5"),
+            output_path=store_path, output_key="seg",
+            tmp_folder=os.path.join(workdir, "tmp"),
+            config_dir=config_dir, max_jobs=max_jobs, target=target,
+            n_scales=1, dependency=ws)
     assert ctt.build([mc], raise_on_failure=True)
     elapsed = time.perf_counter() - t0
     with file_reader(store_path, "r") as f:
@@ -173,8 +190,6 @@ with open({out_path!r}, "wb") as fo:
         if p and ".axon_site" not in p)
     rc = subprocess.call([sys.executable, script], env=env)
     assert rc == 0, "cpu baseline chain failed"
-    import pickle
-
     with open(out_path, "rb") as f:
         return pickle.load(f)
 

@@ -399,6 +399,12 @@ class BlockTask(Task):
                 "script defines tasks at module level, guard the driver code "
                 "with `if __name__ == '__main__':` (as with multiprocessing) "
                 "so workers can import the task class without re-running it.")
+        from ..parallel import multihost as mh
+
+        if mh.process_count() > 1:
+            return self._run_jobs_multiprocess(block_list,
+                                               task_specific_config,
+                                               n_jobs)
         if block_list is None or self.global_task:
             n_jobs = 1
             job_blocks: List[Optional[List[int]]] = [
@@ -467,13 +473,70 @@ class BlockTask(Task):
         self.run_jobs(failed_blocks, task_specific_config, n_jobs=n_jobs,
                       consecutive_blocks=consecutive_blocks)
 
+    def _run_jobs_multiprocess(self, block_list, task_specific_config,
+                               n_jobs: Optional[int] = None) -> None:
+        """Cooperative execution across SPMD processes (multi-host mode,
+        parallel/multihost.py): blockwise tasks shard one job per process
+        round-robin; global tasks AND single-job tasks (n_jobs=1 callers
+        own cross-block state, e.g. the fused chain's running offsets) run
+        on the lead only.  Everyone meets at a filesystem barrier, then
+        every process verifies ALL job logs over the shared store — the
+        reference's many-nodes path (cluster_tasks.py:375-490) with
+        processes instead of sbatch."""
+        from ..parallel import multihost as mh
+
+        pc, pid = mh.process_count(), mh.process_index()
+        global_job = (block_list is None or self.global_task
+                      or n_jobs == 1)
+        if global_job:
+            n_jobs = 1
+            job_blocks: List[Optional[List[int]]] = [
+                None if block_list is None else list(block_list)]
+            my_jobs = [0] if mh.is_lead() else []
+        else:
+            block_list = list(block_list)
+            n_jobs = pc
+            job_blocks = [block_list[j::pc] for j in range(pc)]
+            my_jobs = [pid] if job_blocks[pid] else []
+
+        for job_id in range(n_jobs):
+            if not global_job and not job_blocks[job_id]:
+                continue
+            job_config = {
+                "job_id": job_id, "block_list": job_blocks[job_id],
+                "tmp_folder": self.tmp_folder, "config_dir": self.config_dir,
+                "task_name": self.name_with_id, "target": self.target,
+                "global_config": self.global_config,
+                "config": {**self.task_config, **task_specific_config},
+            }
+            if job_id == pid or (global_job and mh.is_lead()):
+                config_mod.write_config(self.job_config_path(job_id),
+                                        job_config)
+
+        executor = EXECUTORS[self.target]()
+        t0 = time.time()
+        if my_jobs:
+            executor.run(self, my_jobs)
+        mh.fs_barrier(self.tmp_folder, f"{self.name_with_id}_jobs")
+        elapsed = time.time() - t0
+
+        check_jobs = ([0] if global_job else
+                      [j for j in range(n_jobs) if job_blocks[j]])
+        failed = [j for j in check_jobs
+                  if not parse_job_success(self.log_path(j), j)]
+        if failed:
+            self._fail([j for j in failed if j == pid] or failed)
+        self._write_status(n_jobs, block_list, elapsed)
+
     def _fail(self, failed_jobs: List[int]) -> None:
         # rename logs to *_failed.log so the target stays invalid and a driver
         # rerun redoes this task (reference: cluster_tasks.py:143-151)
         for j in failed_jobs:
             lp = self.log_path(j)
-            if os.path.exists(lp):
+            try:
                 os.replace(lp, lp.replace(".log", "_failed.log"))
+            except FileNotFoundError:
+                pass  # another process renamed it first (multiprocess)
         raise FailedJobsError(
             f"{self.name_with_id}: jobs {failed_jobs} failed; "
             f"see {os.path.join(self.tmp_folder, 'logs')}")

@@ -101,6 +101,7 @@ def _load() -> Optional[ctypes.CDLL]:
         p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         lib.skeletonize_3d.argtypes = [p_u8, i64, i64, i64]
         lib.seeded_watershed_u8.argtypes = [p_u8, i64, i64, i64, p_i64]
+        lib.size_filter_u8.argtypes = [p_u8, i64, i64, i64, p_i64, i64]
         _lib = lib
         return _lib
 
@@ -626,6 +627,20 @@ def seeded_watershed_u8(height: np.ndarray, seeds: np.ndarray) -> np.ndarray:
         mask=jnp.asarray(~barrier))
     out = np.asarray(out).astype(np.int64)
     out[barrier] = labels[barrier]
+    return out
+
+
+def size_filter_u8(height: np.ndarray, labels: np.ndarray,
+                   min_size: int) -> np.ndarray:
+    """Remove fragments below ``min_size`` and regrow their voxels from
+    the surviving neighborhood by a LOCAL priority flood (touches only the
+    removed voxels; the reference regrows with a second full watershed).
+    Requires the native library (callers fall back to ops.size_filter)."""
+    if not have_native():
+        raise RuntimeError("size_filter_u8 needs the native library")
+    hq = np.ascontiguousarray(height, dtype=np.uint8)
+    out = np.ascontiguousarray(labels, dtype=np.int64).copy()
+    _load().size_filter_u8(hq, *hq.shape, out, int(min_size))
     return out
 
 

@@ -809,4 +809,69 @@ void seeded_watershed_u8(const uint8_t* height, int64_t sz, int64_t sy,
     }
 }
 
+// Size filter with LOCAL regrow: fragments below min_size are cleared and
+// their voxels re-flooded from the surviving neighborhood — touches only
+// the small fragments' voxels instead of re-running the full watershed
+// (reference semantics: utils/volume_utils.py:123-139 watershed-and-
+// size-filter, which regrows via a second full pass).
+void size_filter_u8(const uint8_t* height, int64_t sz, int64_t sy,
+                    int64_t sx, int64_t* labels, int64_t min_size) {
+    const int64_t n = sz * sy * sx;
+    int64_t max_label = 0;
+    for (int64_t i = 0; i < n; ++i)
+        if (labels[i] > max_label) max_label = labels[i];
+    std::vector<int64_t> counts(max_label + 1, 0);
+    for (int64_t i = 0; i < n; ++i)
+        if (labels[i] > 0) ++counts[labels[i]];
+    std::vector<uint8_t> small(max_label + 1, 0);
+    bool any = false;
+    for (int64_t l = 1; l <= max_label; ++l)
+        if (counts[l] > 0 && counts[l] < min_size) {
+            small[l] = 1;
+            any = true;
+        }
+    if (!any) return;
+    const int64_t strides[3] = {sy * sx, sx, 1};
+    const int64_t dims[3] = {sz, sy, sx};
+    std::vector<std::vector<int64_t>> buckets(256);
+    // clear small fragments; seed the refill queues with their surviving
+    // neighbors
+    for (int64_t i = 0; i < n; ++i)
+        if (labels[i] > 0 && small[labels[i]]) labels[i] = -2;
+    for (int64_t i = 0; i < n; ++i) {
+        if (labels[i] <= 0) continue;
+        const int64_t cz = i / strides[0], cy = (i / sx) % sy, cx = i % sx;
+        const int64_t coord[3] = {cz, cy, cx};
+        bool frontier = false;
+        for (int d = 0; d < 3 && !frontier; ++d)
+            for (int s = -1; s <= 1 && !frontier; s += 2) {
+                const int64_t c = coord[d] + s;
+                if (c < 0 || c >= dims[d]) continue;
+                if (labels[i + s * strides[d]] == -2) frontier = true;
+            }
+        if (frontier) buckets[height[i]].push_back(i);
+    }
+    for (int64_t i = 0; i < n; ++i)
+        if (labels[i] == -2) labels[i] = 0;
+    for (int level = 0; level < 256; ++level) {
+        auto& q = buckets[level];
+        for (size_t h = 0; h < q.size(); ++h) {
+            const int64_t v = q[h];
+            const int64_t coord[3] = {v / strides[0], (v / sx) % sy,
+                                      v % sx};
+            for (int d = 0; d < 3; ++d)
+                for (int s = -1; s <= 1; s += 2) {
+                    const int64_t c = coord[d] + s;
+                    if (c < 0 || c >= dims[d]) continue;
+                    const int64_t u = v + s * strides[d];
+                    if (labels[u] != 0) continue;
+                    labels[u] = labels[v];
+                    const int lu = height[u] < level ? level : height[u];
+                    buckets[lu].push_back(u);
+                }
+        }
+        q.clear();
+    }
+}
+
 }  // extern "C"

@@ -213,7 +213,10 @@ def _compact_tgt(ok, cap: int):
     The target map is computed ONCE per block and shared by every value
     channel (the filter-bank path compacts ~10 responses per block)."""
     idx = jnp.cumsum(ok.astype(jnp.int32)) - 1
-    tgt = jnp.where(ok & (idx < cap), idx, cap)
+    # invalid entries go OUT OF BOUNDS (mode='drop' skips the write) — an
+    # in-bounds dump slot would collect millions of colliding writes, which
+    # TPU scatter serializes (~6 s/pass measured at 2^27)
+    tgt = jnp.where(ok & (idx < cap), idx, cap + 1)
     n_valid = jnp.sum(ok.astype(jnp.int32))
     cok = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(n_valid, cap)
     return tgt, cok, jnp.maximum(n_valid - cap, 0)

@@ -313,9 +313,11 @@ def _basins_impl(height, seeds, mask, connectivity: int, max_rounds: int,
     rank = jnp.cumsum(is_root.astype(jnp.int32)) - 1
     n_basins = jnp.where(n > 0, rank[-1] + 1, 0)
     basin_of = jnp.where(rank[root] < b_cap, rank[root], b_cap)  # (n,)
-    # per-basin label: collision-free scatter at root voxels only
+    # per-basin label: scatter at root voxels only; non-roots go OUT OF
+    # BOUNDS (mode='drop') — an in-bounds dump slot would serialize
+    # millions of colliding writes on TPU
     basin_label0 = jnp.zeros((b_cap + 1,), jnp.int32).at[
-        jnp.where(is_root, basin_of, b_cap)].set(
+        jnp.where(is_root, basin_of, b_cap + 2)].set(
         jnp.where(is_root, seed_flat, 0), mode="drop")
 
     basin_grid = basin_of.reshape(shape)
@@ -326,8 +328,13 @@ def _basins_impl(height, seeds, mask, connectivity: int, max_rounds: int,
         # group resolution in BASIN space (tiny)
         group = jump(bparent)
         glab = blabel[group]
-        vg = group[basin_of]            # voxel -> current group (gather)
-        vlab = glab[basin_of]
+        # ONE voxel-space gather for (group, labeled?): the 19M random
+        # gathers dominate the round cost on TPU (~80 ms each), so group
+        # and label-state ride one packed code
+        code = group * 2 + (glab > 0).astype(jnp.int32)
+        vcode = code[basin_of]
+        vg = vcode >> 1
+        vlab = vcode & 1
         vg_grid = vg.reshape(shape)
 
         # voxel-space stencil: best (saddle, neighbor group) per voxel
@@ -347,7 +354,9 @@ def _basins_impl(height, seeds, mask, connectivity: int, max_rounds: int,
         # with exact worst-case capacities
         ctgt = jnp.cumsum(cand.astype(jnp.int32)) - 1
         ok = ok & (jnp.where(n > 0, ctgt[-1] + 1, 0) <= k_cap)
-        ctgt = jnp.where(cand & (ctgt < k_cap), ctgt, k_cap)
+        # invalid entries scatter OUT OF BOUNDS (mode='drop'): an in-bounds
+        # dump slot would serialize millions of colliding writes on TPU
+        ctgt = jnp.where(cand & (ctgt < k_cap), ctgt, k_cap + 2)
         cg = jnp.full((k_cap + 1,), b_cap, jnp.int32).at[ctgt].set(
             vg, mode="drop")[:k_cap]
         cs = jnp.full((k_cap + 1,), big).at[ctgt].set(sad,

@@ -1,0 +1,177 @@
+"""Multi-host orchestration: jax.distributed plumbing, per-host block
+ownership, filesystem barriers, DCN-aware meshes.
+
+The reference reaches many nodes through its batch system — one sbatch per
+job, the shared filesystem as the data plane (reference:
+cluster_tasks.py:375-490).  The TPU-native replacement keeps the shared
+store as the data plane (it already guarantees race-freedom by
+chunk-aligned writes) and replaces the scheduler with SPMD processes:
+
+* every process runs the SAME driver script; ``jax.distributed.initialize``
+  (or the ``CTT_PROCESS_COUNT``/``CTT_PROCESS_ID`` env pair for CPU smoke
+  tests without a coordination service) tells each process who it is;
+* blockwise tasks shard their block list round-robin per process — process
+  p executes job p of an n_processes-job layout, so the job protocol, the
+  log-line success detection and the per-block retry machinery apply
+  unchanged (core/runtime.py);
+* global (reduce-style) tasks run on the LEAD process only; everyone else
+  waits at a filesystem barrier and then reads the lead's results/logs —
+  the reference's barrier-only synchronization, kept deliberately;
+* device meshes spanning hosts come from ``make_multihost_mesh``: the
+  outer (data/blocks) axis maps across processes over DCN, inner axes stay
+  within a host's chips over ICI (jax.experimental.mesh_utils).
+
+Limits (documented, by design of this round): collectives across processes
+require real multi-host devices (TPU pods) — the CPU smoke test exercises
+ownership + barriers + store cooperation, not cross-process psums; retry
+of a FAILED process's blocks needs an external restart of that process
+(the reference needs the same for a lost node).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Initialize jax.distributed from args or the standard env variables
+    (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID).  No-op when
+    single-process or already initialized."""
+    import jax
+
+    coordinator_address = (coordinator_address
+                           or os.environ.get("COORDINATOR_ADDRESS"))
+    num_processes = num_processes or int(
+        os.environ.get("NUM_PROCESSES", "0")) or None
+    process_id = (process_id if process_id is not None
+                  else int(os.environ.get("PROCESS_ID", "-1")))
+    if coordinator_address is None or num_processes in (None, 1):
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id if process_id >= 0 else None)
+    except RuntimeError:
+        pass  # already initialized
+
+
+def process_count() -> int:
+    """Number of cooperating processes: jax.distributed when initialized,
+    else the CTT_PROCESS_COUNT env (the CPU smoke-test path), else 1."""
+    env = os.environ.get("CTT_PROCESS_COUNT")
+    if env:
+        return int(env)
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def process_index() -> int:
+    env = os.environ.get("CTT_PROCESS_ID")
+    if env:
+        return int(env)
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def is_lead() -> bool:
+    return process_index() == 0
+
+
+def owned_blocks(block_list: Sequence[int]) -> List[int]:
+    """This process's round-robin share of a block list (the reference's
+    ``block_list[job_id::n_jobs]`` layout, cluster_tasks.py:322-332)."""
+    return list(block_list)[process_index()::process_count()]
+
+
+def fs_barrier(tmp_folder: str, name: str, timeout: float = 600.0,
+               poll: float = 0.05) -> None:
+    """Filesystem barrier over the shared tmp folder (the reference's
+    control plane is exactly files + polling; cluster_tasks.py:466-490).
+
+    COUNTER-based so reruns stay correct: each process persists a per-
+    barrier round counter, increments it on entry, and waits until every
+    process's counter reaches its own round — stale sentinels from a
+    previous (crashed or completed) run can never satisfy a new round, and
+    every process passes the same barriers in the same DAG order."""
+    pc = process_count()
+    if pc <= 1:
+        return
+    bdir = os.path.join(tmp_folder, "barriers", name)
+    os.makedirs(bdir, exist_ok=True)
+    mine = os.path.join(bdir, f"p{process_index()}.count")
+    prev = 0
+    if os.path.exists(mine):
+        with open(mine) as f:
+            prev = int(f.read().strip() or 0)
+    my_round = prev + 1
+    tmp = mine + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(my_round))
+    os.replace(tmp, mine)
+    deadline = time.time() + timeout
+    while True:
+        counts = []
+        for p in range(pc):
+            path = os.path.join(bdir, f"p{p}.count")
+            try:
+                with open(path) as f:
+                    counts.append(int(f.read().strip() or 0))
+            except (FileNotFoundError, ValueError):
+                counts.append(0)
+        if all(c >= my_round for c in counts):
+            return
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"barrier {name}: rounds {counts} < {my_round} after "
+                f"{timeout}s")
+        time.sleep(poll)
+
+
+def make_multihost_mesh(axis_names: Sequence[str] = ("data", "model"),
+                        dcn_axis: int = 0):
+    """Mesh spanning all hosts: the ``dcn_axis`` runs across processes
+    (DCN), the remaining axes across each host's local chips (ICI) — the
+    standard hybrid layout (jax.experimental.mesh_utils
+    create_hybrid_device_mesh).  Falls back to a flat mesh when
+    single-process."""
+    import jax
+    from jax.sharding import Mesh
+
+    pc = 1
+    try:
+        pc = jax.process_count()
+    except Exception:
+        pass
+    n_local = max(len(jax.devices()) // max(pc, 1), 1)
+    if pc <= 1:
+        # single host: all devices on the first non-dcn axis
+        sizes = [1] * len(axis_names)
+        other = (dcn_axis + 1) % len(axis_names) if len(axis_names) > 1 \
+            else dcn_axis
+        sizes[other] = len(jax.devices())
+        arr = np.array(jax.devices()).reshape(sizes)
+        return Mesh(arr, tuple(axis_names))
+    from jax.experimental import mesh_utils
+
+    dcn_shape = [1] * len(axis_names)
+    dcn_shape[dcn_axis] = pc
+    ici_shape = [1] * len(axis_names)
+    ici_shape[(dcn_axis + 1) % len(axis_names)] = n_local
+    devices = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=jax.devices())
+    return Mesh(devices, tuple(axis_names))

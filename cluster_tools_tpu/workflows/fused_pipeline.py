@@ -1,0 +1,686 @@
+"""Fused per-block segmentation chain: watershed + relabel + RAG + edge
+features in ONE device program per block.
+
+The classic chain (reference call stack, SURVEY §3.1) runs four blockwise
+passes over the volume — watershed, relabel-write, sub-graph extraction,
+edge-feature accumulation — each re-reading the fragments from the store
+and re-uploading them to the device.  On tunnel/PCIe-attached accelerators
+the link traffic dominates: per [50,512,512] block the split chain moves
+~170 MB across the link; the fused program moves ~65 MB (one raw uint8
+upload, one compact int32 label download, two small tables).
+
+Per block, one jitted program computes:
+  1. normalize -> DT -> seeds -> basin-merge watershed with integrated
+     size filter (ops/watershed._basins_impl);
+  2. DENSE per-block relabel on device (presence + cumsum rank — the
+     RelabelWorkflow becomes unnecessary: the driver adds a running global
+     offset, so the written fragments are globally consecutive);
+  3. interior RAG pairs + per-edge feature statistics
+     (ops/rag.boundary_pair_values + the compacted sort reduction).
+
+Cross-block (face) edges cannot be known in a single pass — the neighbor
+block's ids do not exist yet — so a cheap host task (FusedFaceAssembly)
+adds them afterwards from 2-voxel-thick plane reads, completing the
+per-block sub-graphs in the exact format the merge/solve stack consumes
+(the reference extracts them with a +1 halo inside
+ndist.computeMergeableRegionGraph, graph/initial_sub_graphs.py:114-118).
+
+The assembled problem is bit-compatible with the classic chain: same edge
+sets, same feature statistics (interior + face samples partition the
+reference's sample set), same solver inputs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.blocking import Blocking
+from ..core.runtime import BlockTask
+from ..core.storage import file_reader
+from ..core import graph as g
+from ..core.workflow import FileTarget, Task
+
+
+def _staged_path(tmp_folder: str, block_id: int) -> str:
+    return os.path.join(tmp_folder, f"fused_feats_raw_block_{block_id}.npz")
+
+
+@lru_cache(maxsize=8)
+def _fused_program(outer_shape, halo, threshold: float, sigma_seeds: float,
+                   sigma_weights: float, alpha: float, min_size: int,
+                   e_max: int):
+    """One compiled program per (outer shape, parameter set)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.components import connected_components
+    from ..ops.edt import distance_transform_edt
+    from ..ops.filters import gaussian, local_maxima
+    from ..ops.rag import (_compact_apply, _compact_tgt, _edge_stats_device,
+                           boundary_pair_values)
+    from ..ops.watershed import _basins_impl
+
+    inner_sl = tuple(slice(h, o - h) for h, o in zip(halo, outer_shape))
+    n_outer = int(np.prod(outer_shape))
+
+    @jax.jit
+    def run(x):
+        xf = (x.astype(jnp.float32) * (1.0 / 255.0)
+              if x.dtype == jnp.uint8 else x)
+        fg = xf < threshold
+        dt = distance_transform_edt(fg)
+        hmap = gaussian(xf, sigma_weights) if sigma_weights else xf
+        height = alpha * hmap + (1.0 - alpha) * (
+            1.0 - dt / jnp.maximum(dt.max(), 1e-6))
+        dt_smooth = gaussian(dt, sigma_seeds) if sigma_seeds else dt
+        maxima = local_maxima(dt_smooth, radius=2) & fg
+        seeds = connected_components(maxima, connectivity=3,
+                                     method="propagation")
+        ws, ok = _basins_impl(height, seeds, None, 1, 64, min_size,
+                              max(n_outer // 64, 1024),
+                              max(n_outer // 8, 4096))
+
+        # dense per-block relabel of the INNER region (device-side
+        # np.unique/searchsorted: presence flags + cumsum rank)
+        inner = ws[inner_sl]
+        flat = inner.reshape(-1)
+        pres = jnp.zeros((n_outer + 2,), jnp.int32).at[flat].set(
+            1, mode="drop")
+        pres = pres.at[0].set(0)
+        rank = jnp.cumsum(pres)
+        dense = jnp.where(flat > 0, rank[flat], 0).astype(jnp.int32)
+        k = rank[-1]
+        dense_grid = dense.reshape(inner.shape)
+
+        # interior pairs + boundary samples (both endpoints inside the
+        # inner block; cross-block faces are added by FusedFaceAssembly).
+        # No pow2 padding here: the fused program compiles once per block
+        # config anyway, and padding 78M samples to 134M made the
+        # compaction pass ~70% waste
+        u, v, vals, okp = boundary_pair_values(dense_grid, xf[inner_sl])
+        n = int(u.shape[0])
+        cap = max(1 << max(int(np.ceil(np.log2(max(n // 6, 1)))), 14),
+                  1 << 14)
+        tgt, cok, cap_overflow = _compact_tgt(okp, cap)
+        uv, feats, n_runs, e_overflow = _edge_stats_device(
+            _compact_apply(tgt, u, cap), _compact_apply(tgt, v, cap),
+            _compact_apply(tgt, vals, cap), cok, e_max=e_max)
+        return (dense_grid, k, uv, feats, n_runs,
+                e_overflow + cap_overflow, ok)
+
+    return run
+
+
+@lru_cache(maxsize=8)
+def _hybrid_pre_program(outer_shape, threshold: float, sigma_seeds: float,
+                        sigma_weights: float, alpha: float):
+    """Hybrid stage A: everything BEFORE the flood on device (normalize,
+    EDT, filters, seed detection), returning the uint8-quantized height
+    and the seeds as compact COO — the priority flood itself is a
+    gather-bound serial algorithm that the host C++ bucket queue runs
+    ~2x faster than the TPU Boruvka formulation, so the hybrid mode ships
+    it to the (otherwise idle) host and overlaps it with the next block's
+    device work."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.components import connected_components
+    from ..ops.edt import distance_transform_edt
+    from ..ops.filters import gaussian, local_maxima
+
+    n_outer = int(np.prod(outer_shape))
+    seed_cap = max(n_outer // 64, 1 << 14)
+
+    @jax.jit
+    def run(x):
+        xf = (x.astype(jnp.float32) * (1.0 / 255.0)
+              if x.dtype == jnp.uint8 else x)
+        fg = xf < threshold
+        dt = distance_transform_edt(fg)
+        hmap = gaussian(xf, sigma_weights) if sigma_weights else xf
+        height = alpha * hmap + (1.0 - alpha) * (
+            1.0 - dt / jnp.maximum(dt.max(), 1e-6))
+        dt_smooth = gaussian(dt, sigma_seeds) if sigma_seeds else dt
+        maxima = local_maxima(dt_smooth, radius=2) & fg
+        seeds = connected_components(maxima, connectivity=3,
+                                     method="propagation")
+        hq = jnp.clip(jnp.round(height * 255.0), 0, 255).astype(jnp.uint8)
+        sflat = seeds.reshape(-1)
+        has = sflat > 0
+        tgt = jnp.cumsum(has.astype(jnp.int32)) - 1
+        n_seeds = jnp.where(n_outer > 0, tgt[-1] + 1, 0)
+        tgt = jnp.where(has & (tgt < seed_cap), tgt, seed_cap + 2)
+        pos = jnp.zeros((seed_cap + 1,), jnp.int32).at[tgt].set(
+            jnp.arange(n_outer, dtype=jnp.int32), mode="drop")[:seed_cap]
+        sid = jnp.zeros((seed_cap + 1,), jnp.int32).at[tgt].set(
+            sflat, mode="drop")[:seed_cap]
+        return hq, pos, sid, n_seeds
+
+    return run, seed_cap
+
+
+@lru_cache(maxsize=8)
+def _hybrid_stats_program(outer_shape, halo, e_max: int):
+    """Hybrid stage B: interior RAG pairs + edge statistics over the
+    host-flooded, densely-relabeled inner block (the tail of the fused
+    program; the raw input block stays resident on device between A and
+    B, so only the 4-byte dense labels cross the link again)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.rag import (_compact_apply, _compact_tgt, _edge_stats_device,
+                           boundary_pair_values)
+
+    inner_sl = tuple(slice(h, o - h) for h, o in zip(halo, outer_shape))
+
+    @jax.jit
+    def run(x, dense_inner):
+        xf = (x.astype(jnp.float32) * (1.0 / 255.0)
+              if x.dtype == jnp.uint8 else x)
+        u, v, vals, okp = boundary_pair_values(dense_inner, xf[inner_sl])
+        n = int(u.shape[0])
+        cap = max(1 << max(int(np.ceil(np.log2(max(n // 6, 1)))), 14),
+                  1 << 14)
+        tgt, cok, cap_overflow = _compact_tgt(okp, cap)
+        uv, feats, n_runs, e_overflow = _edge_stats_device(
+            _compact_apply(tgt, u, cap), _compact_apply(tgt, v, cap),
+            _compact_apply(tgt, vals, cap), cok, e_max=e_max)
+        return uv, feats, n_runs, e_overflow + cap_overflow
+
+    return run
+
+
+class FusedSegmentationBlocks(BlockTask):
+    """The fused blockwise pass: fragments written with globally
+    consecutive ids (running offset, single job owns the device) plus
+    staged interior edge/feature tables per block."""
+
+    task_name = "fused_segmentation"
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, problem_path: str, **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.problem_path = problem_path
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({
+            "threshold": 0.25, "sigma_seeds": 2.0, "sigma_weights": 2.0,
+            "size_filter": 25, "alpha": 0.8, "halo": [4, 32, 32],
+            "e_max": 65536, "stream_window": 3,
+        })
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            shape = list(f[self.input_key].shape)
+        block_shape = self.global_block_shape()[-len(shape):]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=shape,
+                              chunks=block_shape, dtype="uint64")
+        block_list = self.blocks_in_volume(shape, block_shape)
+        # one job: the driver owns the device and the running offset
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "problem_path": self.problem_path,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=1)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        import jax.numpy as jnp
+
+        from ..core.runtime import prefetch_iter, stream_window
+        from .watershed import _read_padded_input, run_ws_block
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        halo = (cfg.get("halo") or [0] * blocking.ndim)[-blocking.ndim:]
+        outer_shape = tuple(b + 2 * h
+                            for b, h in zip(cfg["block_shape"], halo))
+        e_max = int(cfg.get("e_max", 65536))
+        program = _fused_program(
+            outer_shape, tuple(halo), float(cfg.get("threshold", 0.25)),
+            float(cfg.get("sigma_seeds", 2.0)),
+            float(cfg.get("sigma_weights", 2.0)),
+            float(cfg.get("alpha", 0.8)),
+            int(cfg.get("size_filter", 25) or 0), e_max)
+
+        f_in = file_reader(cfg["input_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in = f_in[cfg["input_key"]]
+        ds_out = f_out[cfg["output_key"]]
+        tmp_folder = job_config["tmp_folder"]
+
+        state = {"offset": np.uint64(0)}
+        max_ids: Dict[int, int] = {}
+
+        if cfg.get("ws_method") == "hybrid":
+            from .. import native
+
+            if native.have_native():
+                cls._process_hybrid(job_config, log_fn, blocking, halo,
+                                    outer_shape, e_max, ds_in, ds_out,
+                                    tmp_folder, state, max_ids)
+                with file_reader(cfg["output_path"]) as f:
+                    f[cfg["output_key"]].attrs["maxId"] = int(
+                        state["offset"])
+                with open(os.path.join(tmp_folder, "fused_max_ids.json"),
+                          "w") as fo:
+                    json.dump({str(k_): v for k_, v in max_ids.items()},
+                              fo)
+                return
+            log_fn("hybrid ws_method requested but native library "
+                   "unavailable; using the device basin path")
+
+        def submit(entry):
+            bid, data = entry
+            return bid, data, program(jnp.asarray(data))
+
+        def drain(entry):
+            bid, data, handles = entry
+            dense_grid, k, uv, feats, n_runs, overflow, ok = handles
+            block = blocking.get_block(bid)
+            if int(overflow) > 0:
+                raise RuntimeError(
+                    f"block {bid}: edge/compaction capacity exceeded "
+                    f"(e_max={e_max}) — raise e_max or shrink blocks")
+            if not bool(ok):
+                # watershed capacity overflow (pathological heights):
+                # always-correct per-block redo on the host-level path
+                from .watershed import as_normalized_float
+
+                ws = run_ws_block(as_normalized_float(data), cfg)
+                inner_sl = tuple(slice(h, h + (b.stop - b.start))
+                                 for h, b in zip(halo, block.bb))
+                inner = ws[inner_sl]
+                uniq = np.unique(inner)
+                nonzero = uniq[uniq > 0]
+                dense = np.searchsorted(nonzero, inner).astype("uint64") + 1
+                dense[inner == 0] = 0
+                from ..ops.rag import host_boundary_edge_features
+
+                bmap = as_normalized_float(data)[inner_sl]
+                uv_h, feats_h = host_boundary_edge_features(
+                    dense, bmap)
+                k_i = int(nonzero.size)
+                dense_np, uv_np, feats_np = dense, uv_h, feats_h
+            else:
+                k_i = int(k)
+                n_r = int(n_runs)
+                dense_np = np.asarray(dense_grid).astype("uint64")
+                uv_np = np.asarray(uv)[:n_r].astype("int64")
+                feats_np = np.asarray(feats)[:n_r].astype("float64")
+            off = state["offset"]
+            # crop the uniform inner frame to the real (clipped) block
+            real = tuple(slice(0, b.stop - b.start) for b in block.bb)
+            out = dense_np[real].astype("uint64")
+            out[out > 0] += off
+            ds_out[block.bb] = out
+            uv_np = uv_np.astype("uint64") + off
+            np.savez(_staged_path(tmp_folder, bid), uv=uv_np,
+                     feats=feats_np, k=np.int64(k_i),
+                     offset=np.uint64(off))
+            max_ids[bid] = k_i
+            state["offset"] = off + np.uint64(k_i)
+            log_fn(f"processed block {bid}")
+
+        block_ids = list(job_config["block_list"])
+        reads = prefetch_iter(
+            block_ids,
+            lambda bid: (bid, _read_padded_input(
+                ds_in, blocking.get_block(bid), cfg, halo, raw=True)))
+        for _ in stream_window(reads, submit, drain,
+                               window=int(cfg.get("stream_window", 3))):
+            pass
+
+        with file_reader(cfg["output_path"]) as f:
+            f[cfg["output_key"]].attrs["maxId"] = int(state["offset"])
+        with open(os.path.join(tmp_folder, "fused_max_ids.json"), "w") as fo:
+            json.dump({str(k_): v for k_, v in max_ids.items()}, fo)
+
+
+    @classmethod
+    def _process_hybrid(cls, job_config, log_fn, blocking, halo,
+                        outer_shape, e_max, ds_in, ds_out, tmp_folder,
+                        state, max_ids):
+        """Hybrid streaming loop: device stage A (EDT/filters/seeds) ->
+        host C++ flood + local size filter + dense compact -> device stage
+        B (pairs + stats), with a one-block lag so block i's stage B
+        computes while block i+1 floods on the host."""
+        import jax.numpy as jnp
+
+        from .. import native
+        from ..core.runtime import prefetch_iter, stream_window
+        from .watershed import _read_padded_input
+
+        cfg = job_config["config"]
+        n_outer = int(np.prod(outer_shape))
+        pre, seed_cap = _hybrid_pre_program(
+            outer_shape, float(cfg.get("threshold", 0.25)),
+            float(cfg.get("sigma_seeds", 2.0)),
+            float(cfg.get("sigma_weights", 2.0)),
+            float(cfg.get("alpha", 0.8)))
+        stats = _hybrid_stats_program(outer_shape, tuple(halo), e_max)
+        min_size = int(cfg.get("size_filter", 25) or 0)
+
+        from collections import deque
+
+        pending_b = deque()
+
+        def finalize_b():
+            bid, handles = pending_b.popleft()
+            uv, feats, n_runs, overflow = handles
+            if int(overflow) > 0:
+                raise RuntimeError(
+                    f"block {bid}: edge capacity exceeded (e_max={e_max})")
+            n_r = int(n_runs)
+            with np.load(_staged_path(tmp_folder, bid)) as d:
+                k_i, off = int(d["k"]), np.uint64(d["offset"])
+            uv_np = np.asarray(uv)[:n_r].astype("uint64") + off
+            np.savez(_staged_path(tmp_folder, bid),
+                     uv=uv_np, feats=np.asarray(feats)[:n_r].astype(
+                         "float64"), k=np.int64(k_i), offset=off)
+            log_fn(f"processed block {bid}")
+
+        def submit(entry):
+            bid, data = entry
+            x_dev = jnp.asarray(data)
+            return bid, x_dev, pre(x_dev)
+
+        def drain(entry):
+            bid, x_dev, handles = entry
+            hq_d, pos_d, sid_d, n_seeds_d = handles
+            n_seeds = int(n_seeds_d)
+            if n_seeds > seed_cap:
+                raise RuntimeError(
+                    f"block {bid}: {n_seeds} seed voxels exceed the COO "
+                    f"capacity {seed_cap}")
+            hq = np.asarray(hq_d)
+            pos = np.asarray(pos_d)[:n_seeds]
+            sid = np.asarray(sid_d)[:n_seeds]
+            markers = np.zeros(n_outer, "int64")
+            markers[pos] = sid
+            ws = native.seeded_watershed_u8(
+                hq, markers.reshape(outer_shape))
+            if min_size:
+                ws = native.size_filter_u8(hq, ws, min_size)
+            block = blocking.get_block(bid)
+            inner_sl = tuple(slice(h, h + (b.stop - b.start))
+                             for h, b in zip(halo, block.bb))
+            inner = ws[inner_sl]
+            uniq = np.unique(inner)
+            nonzero = uniq[uniq > 0]
+            dense = np.searchsorted(nonzero, inner).astype("int32") + 1
+            dense[inner == 0] = 0
+            k_i = int(nonzero.size)
+            off = state["offset"]
+            out = dense.astype("uint64")
+            out[out > 0] += off
+            ds_out[block.bb] = out
+            np.savez(_staged_path(tmp_folder, bid),
+                     uv=np.zeros((0, 2), "uint64"),
+                     feats=np.zeros((0, 10), "float64"),
+                     k=np.int64(k_i), offset=np.uint64(off))
+            max_ids[bid] = k_i
+            state["offset"] = off + np.uint64(k_i)
+            # pad the (clipped) dense inner back to the uniform frame for
+            # one compiled stage-B program
+            inner_shape = tuple(o - 2 * h for o, h in zip(outer_shape,
+                                                          halo))
+            if dense.shape != inner_shape:
+                dense = np.pad(dense, [(0, i - s) for i, s in
+                                       zip(inner_shape, dense.shape)])
+            pending_b.append((bid, stats(x_dev, jnp.asarray(dense))))
+            if len(pending_b) > 1:
+                finalize_b()
+
+        block_ids = list(job_config["block_list"])
+        reads = prefetch_iter(
+            block_ids,
+            lambda bid: (bid, _read_padded_input(
+                ds_in, blocking.get_block(bid), cfg, halo, raw=True)))
+        for _ in stream_window(reads, submit, drain,
+                               window=int(cfg.get("stream_window", 2))):
+            pass
+        while pending_b:
+            finalize_b()
+
+
+class FusedFaceAssembly(BlockTask):
+    """Add the cross-block face edges (+ their feature samples) from thin
+    plane reads and save the COMPLETE per-block sub-graphs (reference
+    ownership rule: the pair (i, i+1) belongs to the block owning voxel i,
+    so each block contributes its UPPER faces)."""
+
+    task_name = "fused_face_assembly"
+
+    def __init__(self, input_path: str, input_key: str, ws_path: str,
+                 ws_key: str, problem_path: str, **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.problem_path = problem_path
+        super().__init__(**kw)
+
+    def run_impl(self):
+        with file_reader(self.ws_path, "r") as f:
+            shape = list(f[self.ws_key].shape)
+        block_shape = self.global_block_shape()[-len(shape):]
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "ws_path": self.ws_path, "ws_key": self.ws_key,
+            "problem_path": self.problem_path,
+            "shape": shape, "block_shape": block_shape,
+            "fused_tmp": self.tmp_folder,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from ..ops.rag import segmented_stats
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        scale_in = None
+        f_ws = file_reader(cfg["ws_path"], "r")
+        f_in = file_reader(cfg["input_path"], "r")
+        ds_ws = f_ws[cfg["ws_key"]]
+        ds_in = f_in[cfg["input_key"]]
+        if np.issubdtype(ds_in.dtype, np.integer):
+            scale_in = float(np.iinfo(ds_in.dtype).max)
+
+        for bid in job_config["block_list"]:
+            with np.load(_staged_path(cfg["fused_tmp"], bid)) as d:
+                uv_int = d["uv"]
+                feats_int = d["feats"]
+                k = int(d["k"])
+                off = int(d["offset"])
+            block = blocking.get_block(bid)
+            face_u, face_v, face_x = [], [], []
+            extra_nodes = []  # +1-halo labels: the classic sub-graph node
+            #                   set includes them (reference reads the
+            #                   block with increaseRoi)
+            for axis in range(blocking.ndim):
+                nb = blocking.neighbor_id(bid, axis, +1)
+                if nb is None:
+                    continue
+                hi = block.end[axis]
+                bb_lo = tuple(
+                    slice(hi - 1, hi) if d_ == axis else s
+                    for d_, s in enumerate(block.bb))
+                bb_hi = tuple(
+                    slice(hi, hi + 1) if d_ == axis else s
+                    for d_, s in enumerate(block.bb))
+                la = np.asarray(ds_ws[bb_lo]).ravel()
+                lb = np.asarray(ds_ws[bb_hi]).ravel()
+                extra_nodes.append(np.unique(lb[lb > 0]))
+                xa = np.asarray(ds_in[bb_lo]).ravel().astype("float64")
+                xb = np.asarray(ds_in[bb_hi]).ravel().astype("float64")
+                if scale_in:
+                    xa = xa / scale_in
+                    xb = xb / scale_in
+                fg = (la > 0) & (lb > 0) & (la != lb)
+                if not fg.any():
+                    continue
+                u = np.minimum(la[fg], lb[fg])
+                v = np.maximum(la[fg], lb[fg])
+                # two samples per face pair (nifty gridRag convention)
+                face_u.extend([u, u])
+                face_v.extend([v, v])
+                face_x.extend([xa[fg], xb[fg]])
+            if face_u:
+                fu = np.concatenate(face_u)
+                fv = np.concatenate(face_v)
+                fx = np.concatenate(face_x)
+                uv_pairs = np.stack([fu, fv], axis=1)
+                uniq, inv = np.unique(uv_pairs, axis=0, return_inverse=True)
+                feats_face = segmented_stats(inv, fx, len(uniq))
+                uv_all = np.concatenate([uv_int, uniq.astype("uint64")])
+                feats_all = np.concatenate([feats_int, feats_face])
+            else:
+                uv_all, feats_all = uv_int, feats_int
+            order = np.lexsort((uv_all[:, 1], uv_all[:, 0]))
+            uv_all, feats_all = uv_all[order], feats_all[order]
+            nodes = np.arange(off + 1, off + k + 1, dtype="uint64")
+            if extra_nodes:
+                nodes = np.unique(np.concatenate(
+                    [nodes] + [e.astype("uint64") for e in extra_nodes]))
+            g.save_sub_graph(cfg["problem_path"], 0, bid, nodes,
+                             uv_all.astype("uint64"))
+            np.savez(_staged_path(cfg["fused_tmp"], bid) + ".full.npz",
+                     uv=uv_all.astype("uint64"), feats=feats_all)
+            log_fn(f"processed block {bid}")
+
+
+class FeatureTablesToIds(BlockTask):
+    """Join the staged (uv, feats) tables with the global edge ids (after
+    MergeSubGraphs + MapEdgeIds) and write the per-block feature files in
+    the format MergeEdgeFeatures consumes."""
+
+    task_name = "fused_feature_ids"
+
+    def __init__(self, ws_path: str, ws_key: str, problem_path: str, **kw):
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.problem_path = problem_path
+        super().__init__(**kw)
+
+    def run_impl(self):
+        with file_reader(self.ws_path, "r") as f:
+            shape = list(f[self.ws_key].shape)
+        block_shape = self.global_block_shape()[-len(shape):]
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "problem_path": self.problem_path,
+            "shape": shape, "block_shape": block_shape,
+            "fused_tmp": self.tmp_folder,
+        }, n_jobs=self.max_jobs)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from .features import _block_feature_path
+
+        cfg = job_config["config"]
+        os.makedirs(os.path.dirname(
+            _block_feature_path(cfg["problem_path"], 0)), exist_ok=True)
+        for bid in job_config["block_list"]:
+            data = g.load_sub_graph(cfg["problem_path"], 0, bid)
+            with np.load(_staged_path(cfg["fused_tmp"], bid)
+                         + ".full.npz") as d:
+                uv = d["uv"]
+                feats = d["feats"]
+            local = g.find_edge_ids(data["edges"], uv)
+            out = np.zeros((len(data["edges"]), feats.shape[1] if
+                            len(feats) else 10), "float64")
+            out[local] = feats
+            np.savez(_block_feature_path(cfg["problem_path"], bid),
+                     edge_ids=data["edge_ids"].astype("int64"),
+                     features=out)
+            log_fn(f"processed block {bid}")
+
+
+class FusedProblemWorkflow(Task):
+    """Fused analog of WatershedWorkflow + ProblemWorkflow: fragments +
+    graph + features + costs from one device pass per block plus cheap
+    host assembly (the ``target='tpu'`` fast path of
+    MulticutSegmentationWorkflow)."""
+
+    def __init__(self, input_path: str, input_key: str, ws_path: str,
+                 ws_key: str, problem_path: str, tmp_folder: str,
+                 config_dir: str, max_jobs: int = 1, target: str = "tpu",
+                 compute_costs: bool = True,
+                 dependency: Optional[Task] = None):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.ws_path = ws_path
+        self.ws_key = ws_key
+        self.problem_path = problem_path
+        self.compute_costs = compute_costs
+        self.tmp_folder = tmp_folder
+        self.config_dir = config_dir
+        self.max_jobs = max_jobs
+        self.target = target
+        self.dependency = dependency
+        super().__init__()
+
+    def _common(self):
+        return dict(tmp_folder=self.tmp_folder, config_dir=self.config_dir,
+                    max_jobs=self.max_jobs, target=self.target)
+
+    def requires(self):
+        from .costs import EdgeCostsWorkflow
+        from .features import MergeEdgeFeatures
+        from .graph import MapEdgeIds, MergeSubGraphs
+
+        fused = FusedSegmentationBlocks(
+            input_path=self.input_path, input_key=self.input_key,
+            output_path=self.ws_path, output_key=self.ws_key,
+            problem_path=self.problem_path, dependency=self.dependency,
+            **self._common())
+        faces = FusedFaceAssembly(
+            input_path=self.input_path, input_key=self.input_key,
+            ws_path=self.ws_path, ws_key=self.ws_key,
+            problem_path=self.problem_path, dependency=fused,
+            **self._common())
+        merge = MergeSubGraphs(
+            graph_path=self.problem_path, scale=0,
+            merge_complete_graph=True, output_key="s0/graph",
+            input_path=self.ws_path, input_key=self.ws_key,
+            dependency=faces, **self._common())
+        mapped = MapEdgeIds(
+            graph_path=self.problem_path, scale=0, graph_key="s0/graph",
+            input_path=self.ws_path, input_key=self.ws_key,
+            dependency=merge, **self._common())
+        feat_ids = FeatureTablesToIds(
+            ws_path=self.ws_path, ws_key=self.ws_key,
+            problem_path=self.problem_path, dependency=mapped,
+            **self._common())
+        merged_feats = MergeEdgeFeatures(
+            graph_path=self.problem_path, graph_key="s0/graph",
+            output_path=self.problem_path, output_key="features",
+            dependency=feat_ids, **self._common())
+        if not self.compute_costs:
+            return merged_feats
+        return EdgeCostsWorkflow(
+            features_path=self.problem_path, features_key="features",
+            output_path=self.problem_path, output_key="s0/costs",
+            graph_path=self.problem_path, graph_key="s0/graph",
+            dependency=merged_feats, **self._common())
+
+    def output(self):
+        name = ("probs_to_costs.status" if self.compute_costs
+                else "merge_edge_features.status")
+        return FileTarget(os.path.join(self.tmp_folder, name))

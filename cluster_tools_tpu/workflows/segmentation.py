@@ -94,6 +94,7 @@ class MulticutSegmentationWorkflow(Task):
                  max_jobs: int = 1, target: str = "local",
                  n_scales: int = 1,
                  offsets: Optional[List[List[int]]] = None,
+                 fused: bool = False,
                  dependency: Optional[Task] = None):
         self.input_path = input_path
         self.input_key = input_key
@@ -104,6 +105,11 @@ class MulticutSegmentationWorkflow(Task):
         self.output_key = output_key
         self.n_scales = n_scales
         self.offsets = offsets
+        #: fused=True computes the watershed fragments INSIDE the problem
+        #: assembly (one device program per block: ws + relabel + RAG +
+        #: features, workflows/fused_pipeline.py) — no WatershedWorkflow
+        #: dependency needed; ws_path/ws_key become outputs
+        self.fused = fused
         self.tmp_folder = tmp_folder
         self.config_dir = config_dir
         self.max_jobs = max_jobs
@@ -118,11 +124,23 @@ class MulticutSegmentationWorkflow(Task):
     def requires(self):
         assignment_path = os.path.join(self.tmp_folder,
                                        "multicut_assignments.npy")
-        problem = ProblemWorkflow(
-            input_path=self.input_path, input_key=self.input_key,
-            ws_path=self.ws_path, ws_key=self.ws_key,
-            problem_path=self.problem_path, offsets=self.offsets,
-            dependency=self.dependency, **self._common())
+        if self.fused:
+            if self.offsets is not None:
+                raise ValueError("fused=True supports boundary maps only "
+                                 "(affinity offsets need the split chain)")
+            from .fused_pipeline import FusedProblemWorkflow
+
+            problem = FusedProblemWorkflow(
+                input_path=self.input_path, input_key=self.input_key,
+                ws_path=self.ws_path, ws_key=self.ws_key,
+                problem_path=self.problem_path,
+                dependency=self.dependency, **self._common())
+        else:
+            problem = ProblemWorkflow(
+                input_path=self.input_path, input_key=self.input_key,
+                ws_path=self.ws_path, ws_key=self.ws_key,
+                problem_path=self.problem_path, offsets=self.offsets,
+                dependency=self.dependency, **self._common())
         multicut = MulticutWorkflow(
             problem_path=self.problem_path, assignment_path=assignment_path,
             n_scales=self.n_scales, dependency=problem, **self._common())

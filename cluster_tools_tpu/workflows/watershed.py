@@ -43,6 +43,14 @@ def _normalize_input(data: np.ndarray, cfg) -> np.ndarray:
     return data
 
 
+def as_normalized_float(block: np.ndarray) -> np.ndarray:
+    """Raw-path inverse: a uint8 block back to the [0,1] float scale the
+    device pipeline uses (shared by every raw-read fallback site)."""
+    if block.dtype == np.uint8:
+        return block.astype("float32") / 255.0
+    return np.asarray(block)
+
+
 def _channel_slice(ds, cfg):
     cb = cfg.get("channel_begin", 0)
     ce = cfg.get("channel_end", None)
@@ -309,9 +317,7 @@ def iter_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
     def _fallback(b):
         # capacity overflow (pathological height field): redo this block
         # through the always-correct per-block path
-        data = b.astype("float32") / 255.0 if b.dtype == np.uint8 \
-            else np.asarray(b)
-        return run_ws_block(data, cfg)
+        return run_ws_block(as_normalized_float(b), cfg)
 
     def drain(entry):
         b, handles = entry
@@ -652,9 +658,8 @@ class WatershedTask(BlockTask):
                 if len({b.dtype for b in pending}) > 1:
                     # a degenerate block came back float (host-normalized);
                     # normalize the uint8 ones so the round is uniform
-                    pending[:] = [
-                        b.astype("float32") / 255.0 if b.dtype == np.uint8
-                        else b for b in pending]
+                    pending[:] = [as_normalized_float(b)
+                                  for b in pending]
                 batch = np.stack(
                     pending + [pending[-1]] * (n_dev - len(pending)))
                 dev = jax.device_put(jnp.asarray(batch), sharding)
@@ -670,10 +675,8 @@ class WatershedTask(BlockTask):
                 for k, bid in enumerate(pending_ids):
                     if not oks[k]:
                         # capacity overflow: always-correct per-block redo
-                        b = pending[k]
-                        data = (b.astype("float32") / 255.0
-                                if b.dtype == np.uint8 else b)
-                        ws = run_ws_block(data, cfg)
+                        ws = run_ws_block(as_normalized_float(pending[k]),
+                                          cfg)
                     else:
                         ws = ws_all[k]
                         if heights is not None:
