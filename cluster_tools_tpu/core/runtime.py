@@ -499,6 +499,12 @@ class BlockTask(Task):
             job_blocks = [block_list[j::pc] for j in range(pc)]
             my_jobs = [pid] if job_blocks[pid] else []
 
+        import inspect
+
+        try:
+            src_file = inspect.getfile(type(self))
+        except TypeError:
+            src_file = None
         for job_id in range(n_jobs):
             if not global_job and not job_blocks[job_id]:
                 continue
@@ -506,6 +512,7 @@ class BlockTask(Task):
                 "job_id": job_id, "block_list": job_blocks[job_id],
                 "tmp_folder": self.tmp_folder, "config_dir": self.config_dir,
                 "task_name": self.name_with_id, "target": self.target,
+                "src_file": src_file,
                 "global_config": self.global_config,
                 "config": {**self.task_config, **task_specific_config},
             }

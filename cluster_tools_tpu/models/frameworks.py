@@ -78,16 +78,48 @@ def make_torch_predictor(checkpoint_path: str, outer_shape: Sequence[int],
     return predict
 
 
+def wrap_tta(predict, mode: str):
+    """Test-time augmentation over the 8 mirror variants: predict each
+    axis-flip combination of the block, invert the flip on the output,
+    average (the reference's inferno/neurofire TestTimeAugmenter path,
+    inference/frameworks.py:90-113).  Framework-agnostic wrapper around
+    any block predictor; 8x the forward cost, channel axis untouched."""
+    if not mode:
+        return predict
+    if mode != "mirror":
+        raise ValueError(f"unknown tta mode {mode!r} "
+                         "(available: 'mirror')")
+    import itertools
+
+    def predict_tta(block: np.ndarray) -> np.ndarray:
+        spatial_off = block.ndim - 3
+        acc = None
+        for flips in itertools.product([False, True], repeat=3):
+            axes = tuple(spatial_off + d for d, f in enumerate(flips) if f)
+            xb = np.flip(block, axes) if axes else block
+            y = predict(np.ascontiguousarray(xb))  # (C_out, *inner)
+            out_axes = tuple(1 + d for d, f in enumerate(flips) if f)
+            if out_axes:
+                y = np.flip(y, out_axes)
+            acc = y.astype("float64") if acc is None else acc + y
+        return (acc / 8.0).astype("float32")
+
+    return predict_tta
+
+
 def get_predictor(framework: str, checkpoint_path: str,
                   outer_shape: Sequence[int], halo: Sequence[int],
-                  preprocess: str = "standardize"):
+                  preprocess: str = "standardize",
+                  tta: str = ""):
     """Framework dispatch (reference: inference/frameworks.py:118-130)."""
     if framework == "self":
         from ..workflows.inference import make_predictor
 
-        return make_predictor(checkpoint_path, outer_shape, halo, preprocess)
-    if framework == "pytorch":
-        return make_torch_predictor(checkpoint_path, outer_shape, halo,
-                                    preprocess)
-    raise KeyError(f"Framework {framework} not supported "
-                   "(available: 'self', 'pytorch')")
+        fn = make_predictor(checkpoint_path, outer_shape, halo, preprocess)
+    elif framework == "pytorch":
+        fn = make_torch_predictor(checkpoint_path, outer_shape, halo,
+                                  preprocess)
+    else:
+        raise KeyError(f"Framework {framework} not supported "
+                       "(available: 'self', 'pytorch')")
+    return wrap_tta(fn, tta)

@@ -834,8 +834,10 @@ void size_filter_u8(const uint8_t* height, int64_t sz, int64_t sy,
     const int64_t strides[3] = {sy * sx, sx, 1};
     const int64_t dims[3] = {sz, sy, sx};
     std::vector<std::vector<int64_t>> buckets(256);
-    // clear small fragments; seed the refill queues with their surviving
-    // neighbors
+    // clear small fragments to the -2 sentinel; seed the refill queues
+    // with their surviving neighbors.  The flood expands ONLY into -2
+    // voxels, so pre-existing background (label 0, e.g. masked regions)
+    // is never claimed — the regrow touches exactly the removed voxels.
     for (int64_t i = 0; i < n; ++i)
         if (labels[i] > 0 && small[labels[i]]) labels[i] = -2;
     for (int64_t i = 0; i < n; ++i) {
@@ -851,8 +853,6 @@ void size_filter_u8(const uint8_t* height, int64_t sz, int64_t sy,
             }
         if (frontier) buckets[height[i]].push_back(i);
     }
-    for (int64_t i = 0; i < n; ++i)
-        if (labels[i] == -2) labels[i] = 0;
     for (int level = 0; level < 256; ++level) {
         auto& q = buckets[level];
         for (size_t h = 0; h < q.size(); ++h) {
@@ -864,7 +864,7 @@ void size_filter_u8(const uint8_t* height, int64_t sz, int64_t sy,
                     const int64_t c = coord[d] + s;
                     if (c < 0 || c >= dims[d]) continue;
                     const int64_t u = v + s * strides[d];
-                    if (labels[u] != 0) continue;
+                    if (labels[u] != -2) continue;
                     labels[u] = labels[v];
                     const int lu = height[u] < level ? level : height[u];
                     buckets[lu].push_back(u);
@@ -872,6 +872,9 @@ void size_filter_u8(const uint8_t* height, int64_t sz, int64_t sy,
         }
         q.clear();
     }
+    // unreachable removed voxels (no surviving neighbor path) become 0
+    for (int64_t i = 0; i < n; ++i)
+        if (labels[i] == -2) labels[i] = 0;
 }
 
 }  // extern "C"

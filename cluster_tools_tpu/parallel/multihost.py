@@ -11,9 +11,12 @@ chunk-aligned writes) and replaces the scheduler with SPMD processes:
   (or the ``CTT_PROCESS_COUNT``/``CTT_PROCESS_ID`` env pair for CPU smoke
   tests without a coordination service) tells each process who it is;
 * blockwise tasks shard their block list round-robin per process — process
-  p executes job p of an n_processes-job layout, so the job protocol, the
-  log-line success detection and the per-block retry machinery apply
-  unchanged (core/runtime.py);
+  p executes job p of an n_processes-job layout, so the job protocol and
+  the log-line success detection apply unchanged (core/runtime.py).
+  Block-granular RETRY is driver-rerun only in this mode: a failed job
+  fails the task on every process, and re-running the driver script
+  redoes the incomplete tasks (the single-process in-run retry loop would
+  need a cross-process consensus on the failed-block set);
 * global (reduce-style) tasks run on the LEAD process only; everyone else
   waits at a filesystem barrier and then reads the lead's results/logs —
   the reference's barrier-only synchronization, kept deliberately;

@@ -69,7 +69,7 @@ def _fused_program(outer_shape, halo, threshold: float, sigma_seeds: float,
     n_outer = int(np.prod(outer_shape))
 
     @jax.jit
-    def run(x):
+    def run(x, extent):
         xf = (x.astype(jnp.float32) * (1.0 / 255.0)
               if x.dtype == jnp.uint8 else x)
         fg = xf < threshold
@@ -86,8 +86,18 @@ def _fused_program(outer_shape, halo, threshold: float, sigma_seeds: float,
                               max(n_outer // 8, 4096))
 
         # dense per-block relabel of the INNER region (device-side
-        # np.unique/searchsorted: presence flags + cumsum rank)
+        # np.unique/searchsorted: presence flags + cumsum rank).
+        # ``extent`` is the REAL (clipped) inner size of border blocks:
+        # the reflect-padded remainder is zeroed so phantom fragments in
+        # the pad never enter the rank, the id count, or the pair set
         inner = ws[inner_sl]
+        valid = jnp.ones(inner.shape, bool)
+        for d in range(inner.ndim):
+            coord = jnp.arange(inner.shape[d])
+            shape_d = [1] * inner.ndim
+            shape_d[d] = inner.shape[d]
+            valid &= (coord < extent[d]).reshape(shape_d)
+        inner = jnp.where(valid, inner, 0)
         flat = inner.reshape(-1)
         pres = jnp.zeros((n_outer + 2,), jnp.int32).at[flat].set(
             1, mode="drop")
@@ -286,7 +296,10 @@ class FusedSegmentationBlocks(BlockTask):
 
         def submit(entry):
             bid, data = entry
-            return bid, data, program(jnp.asarray(data))
+            block = blocking.get_block(bid)
+            extent = jnp.asarray([b.stop - b.start for b in block.bb],
+                                 dtype=jnp.int32)
+            return bid, data, program(jnp.asarray(data), extent)
 
         def drain(entry):
             bid, data, handles = entry

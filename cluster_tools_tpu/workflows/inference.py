@@ -161,7 +161,7 @@ class InferenceTask(BlockTask):
     def default_task_config():
         conf = BlockTask.default_task_config()
         conf.update({"dtype": "uint8", "preprocess": "standardize",
-                     "framework": "self",
+                     "framework": "self", "tta": "",
                      "channel_begin": 0, "channel_end": None})
         return conf
 
@@ -219,7 +219,8 @@ class InferenceTask(BlockTask):
         outer_shape = tuple(bs + 2 * h for bs, h in zip(block_shape, halo))
         predict = get_predictor(cfg.get("framework", "self"),
                                 cfg["checkpoint_path"], outer_shape, halo,
-                                cfg.get("preprocess", "standardize"))
+                                cfg.get("preprocess", "standardize"),
+                                tta=cfg.get("tta", ""))
         n_threads = int(cfg.get("threads_per_job", 1)) or 1
 
         # channel selection for 4D (C, Z, Y, X) inputs (reference channel

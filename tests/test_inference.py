@@ -237,3 +237,43 @@ def test_get_predictor_unknown_framework():
 
     with pytest.raises(KeyError):
         get_predictor("tensorflow", "x", (8, 8, 8), (0, 0, 0))
+
+
+def test_tta_mirror_wrapper():
+    """wrap_tta averages the 8 mirror variants with correct inversion."""
+    from cluster_tools_tpu.models.frameworks import wrap_tta
+
+    rng = np.random.RandomState(0)
+
+    # a predictor equivariant under flips (elementwise): TTA == plain
+    def equivariant(block):
+        return (block * 2.0)[None].astype("float32")
+
+    x = rng.rand(6, 8, 8).astype("float32")
+    plain = equivariant(x)
+    tta = wrap_tta(equivariant, "mirror")(x)
+    np.testing.assert_allclose(tta, plain, rtol=1e-6)
+
+    # a non-equivariant predictor: TTA equals the hand-computed average
+    def shifted(block):
+        out = np.zeros_like(block)
+        out[1:] = block[:-1]  # shift along z
+        return out[None].astype("float32")
+
+    tta = wrap_tta(shifted, "mirror")(x)
+    import itertools
+
+    acc = np.zeros((1,) + x.shape, "float64")
+    for flips in itertools.product([False, True], repeat=3):
+        axes = tuple(d for d, f in enumerate(flips) if f)
+        xb = np.flip(x, axes) if axes else x
+        y = shifted(np.ascontiguousarray(xb))
+        oaxes = tuple(1 + d for d, f in enumerate(flips) if f)
+        acc += np.flip(y, oaxes) if oaxes else y
+    np.testing.assert_allclose(tta, (acc / 8).astype("float32"), rtol=1e-6)
+
+    # unknown mode raises
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown tta mode"):
+        wrap_tta(shifted, "rotate")
