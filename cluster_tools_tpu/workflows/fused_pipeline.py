@@ -441,7 +441,12 @@ class FusedSegmentationBlocks(BlockTask):
             off = state["offset"]
             out = dense.astype("uint64")
             out[out > 0] += off
-            ds_out[block.bb] = out
+            # store write off the critical path: chunk-aligned disjoint
+            # blocks, single writer thread — overlaps the next block's
+            # flood; the pool is drained before the job (and therefore the
+            # face-assembly task that reads these planes) completes
+            write_futures.append(
+                writer.submit(ds_out.__setitem__, block.bb, out))
             np.savez(_staged_path(tmp_folder, bid),
                      uv=np.zeros((0, 2), "uint64"),
                      feats=np.zeros((0, 10), "float64"),
@@ -459,16 +464,22 @@ class FusedSegmentationBlocks(BlockTask):
             if len(pending_b) > 1:
                 finalize_b()
 
+        from concurrent.futures import ThreadPoolExecutor
+
         block_ids = list(job_config["block_list"])
         reads = prefetch_iter(
             block_ids,
             lambda bid: (bid, _read_padded_input(
                 ds_in, blocking.get_block(bid), cfg, halo, raw=True)))
-        for _ in stream_window(reads, submit, drain,
-                               window=int(cfg.get("stream_window", 2))):
-            pass
-        while pending_b:
-            finalize_b()
+        write_futures: List = []
+        with ThreadPoolExecutor(1) as writer:
+            for _ in stream_window(reads, submit, drain,
+                                   window=int(cfg.get("stream_window", 2))):
+                pass
+            while pending_b:
+                finalize_b()
+            for fut in write_futures:
+                fut.result()  # surface any store-write failure
 
 
 class FusedFaceAssembly(BlockTask):
