@@ -202,8 +202,9 @@ def task_profile(workdir):
     for sf in sorted(glob.glob(os.path.join(workdir, "tmp", "*.status"))):
         with open(sf) as f:
             st = json.load(f)
-        rows.append((st.get("wall_time", 0.0), st["task"], st.get("n_blocks")))
-    return sorted(rows, reverse=True)
+        rows.append((st.get("wall_time", 0.0), st["task"], st.get("n_blocks"),
+                     st.get("stages") or {}))
+    return sorted(rows, key=lambda r: -r[0])
 
 
 def metrics(seg, gt):
@@ -250,9 +251,10 @@ def main():
     dev_t, dev_seg = run_chain(full_store, SHAPE,
                                os.path.join(base, "dev_timed"), "tpu")
     profile = task_profile(os.path.join(base, "dev_timed"))
-    for wall, task, n_blocks in profile[:8]:
+    for wall, task, n_blocks, stages in profile[:8]:
+        stage_txt = " ".join(f"{k}={v:.1f}" for k, v in stages.items())
         print(f"  device task {task:40s} wall={wall:7.2f}s "
-              f"n_blocks={n_blocks}", file=sys.stderr, flush=True)
+              f"n_blocks={n_blocks} {stage_txt}", file=sys.stderr, flush=True)
 
     cpu_t, cpu_seg = run_cpu_chain_subprocess(cpu_store, CPU_SHAPE,
                                               os.path.join(base, "cpu"))

@@ -41,6 +41,76 @@ from .workflow import FileTarget, Task
 
 _BLOCK_SUCCESS = "processed block"
 _JOB_SUCCESS = "processed job"
+_STAGE_LINE = "stage times"
+
+# ---------------------------------------------------------------------------
+# per-stage accounting (VERDICT r3 item 4): tasks attribute wall time to
+# named stages (device-compute, host-compute, store-io, sync-wait, ...) via
+# the ``stage`` context manager / ``stage_add``; ``run_jobs`` snapshots the
+# accumulator around the executor and writes the delta into the status JSON.
+# Subprocess workers print their stages as a log line that the driver parses
+# (same channel as the block-success protocol).
+# ---------------------------------------------------------------------------
+
+_STAGE_ACC: Dict[str, float] = {}
+_STAGE_LOCK = threading.Lock()
+
+
+def stage_add(name: str, seconds: float) -> None:
+    with _STAGE_LOCK:
+        _STAGE_ACC[name] = _STAGE_ACC.get(name, 0.0) + float(seconds)
+
+
+class stage:
+    """Context manager attributing elapsed wall time to a named stage."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        stage_add(self.name, time.perf_counter() - self._t0)
+        return False
+
+
+def stages_snapshot() -> Dict[str, float]:
+    with _STAGE_LOCK:
+        return dict(_STAGE_ACC)
+
+
+def stages_delta(before: Dict[str, float]) -> Dict[str, float]:
+    now = stages_snapshot()
+    out = {k: v - before.get(k, 0.0) for k, v in now.items()
+           if v - before.get(k, 0.0) > 1e-4}
+    return out
+
+
+def log_stage_times() -> None:
+    """Emit the worker-side stage accumulator as a parseable log line."""
+    st = stages_snapshot()
+    if st:
+        log(f"{_STAGE_LINE} {json.dumps({k: round(v, 3) for k, v in st.items()})}")
+
+
+def parse_stage_times(log_path: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    if not os.path.exists(log_path):
+        return out
+    with open(log_path) as f:
+        for line in f:
+            pos = line.find(_STAGE_LINE + " {")
+            if pos < 0:
+                continue
+            try:
+                d = json.loads(line[pos + len(_STAGE_LINE):].strip())
+            except json.JSONDecodeError:
+                continue
+            for k, v in d.items():
+                out[k] = out.get(k, 0.0) + float(v)
+    return out
 
 
 def log(msg: str, stream=None) -> None:
@@ -439,6 +509,7 @@ class BlockTask(Task):
             config_mod.write_config(self.job_config_path(job_id), job_config)
 
         executor = EXECUTORS[self.target]()
+        stages_before = stages_snapshot()
         t0 = time.time()
         executor.run(self, list(range(n_jobs)))
         elapsed = time.time() - t0
@@ -447,7 +518,8 @@ class BlockTask(Task):
         failed_jobs = [j for j in range(n_jobs)
                        if not parse_job_success(self.log_path(j), j)]
         if not failed_jobs:
-            self._write_status(n_jobs, block_list, elapsed)
+            self._write_status(n_jobs, block_list, elapsed,
+                               stages_delta(stages_before))
             return
 
         if (not self.allow_retry
@@ -521,6 +593,7 @@ class BlockTask(Task):
                                         job_config)
 
         executor = EXECUTORS[self.target]()
+        stages_before = stages_snapshot()
         t0 = time.time()
         if my_jobs:
             executor.run(self, my_jobs)
@@ -533,7 +606,8 @@ class BlockTask(Task):
                   if not parse_job_success(self.log_path(j), j)]
         if failed:
             self._fail([j for j in failed if j == pid] or failed)
-        self._write_status(n_jobs, block_list, elapsed)
+        self._write_status(n_jobs, block_list, elapsed,
+                           stages_delta(stages_before))
 
     def _fail(self, failed_jobs: List[int]) -> None:
         # rename logs to *_failed.log so the target stays invalid and a driver
@@ -548,9 +622,16 @@ class BlockTask(Task):
             f"{self.name_with_id}: jobs {failed_jobs} failed; "
             f"see {os.path.join(self.tmp_folder, 'logs')}")
 
-    def _write_status(self, n_jobs: int, block_list, elapsed: float) -> None:
+    def _write_status(self, n_jobs: int, block_list, elapsed: float,
+                      stages: Optional[Dict[str, float]] = None) -> None:
         runtimes = [parse_job_runtime(self.log_path(j)) for j in range(n_jobs)]
         runtimes = [r for r in runtimes if r is not None]
+        # subprocess workers report their stages through the job log (the
+        # driver-process accumulator only sees in-process executors)
+        stages = dict(stages or {})
+        for j in range(n_jobs):
+            for k, v in parse_stage_times(self.log_path(j)).items():
+                stages[k] = stages.get(k, 0.0) + v
         status = {
             "task": self.name_with_id,
             "n_jobs": n_jobs,
@@ -558,6 +639,8 @@ class BlockTask(Task):
             "wall_time": elapsed,
             "job_runtime_mean": float(sum(runtimes) / len(runtimes)) if runtimes else None,
             "retries": self._retry_count,
+            "stages": {k: round(v, 3) for k, v in sorted(
+                stages.items(), key=lambda kv: -kv[1])},
         }
         config_mod.write_config(self.output().path, status)
 

@@ -50,6 +50,9 @@ def main(argv) -> int:
         log(msg)
 
     task_cls.process_job(job_id, job_config, log_fn)
+    from .runtime import log_stage_times
+
+    log_stage_times()
     log_job_success(job_id)
     return 0
 
