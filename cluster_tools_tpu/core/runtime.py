@@ -606,8 +606,14 @@ class BlockTask(Task):
                   if not parse_job_success(self.log_path(j), j)]
         if failed:
             self._fail([j for j in failed if j == pid] or failed)
-        self._write_status(n_jobs, block_list, elapsed,
-                           stages_delta(stages_before))
+        if mh.is_lead():
+            # single writer for the shared status file; its stages cover
+            # the lead's own jobs (peers' inline stages stay local)
+            self._write_status(n_jobs, block_list, elapsed,
+                               stages_delta(stages_before))
+        # peers must not observe the task incomplete (build() verifies
+        # the target right after run) — wait for the lead's write
+        mh.fs_barrier(self.tmp_folder, f"{self.name_with_id}_status")
 
     def _fail(self, failed_jobs: List[int]) -> None:
         # rename logs to *_failed.log so the target stays invalid and a driver

@@ -34,15 +34,21 @@ def main(argv) -> int:
             raise ModuleNotFoundError("__main__")
         module = importlib.import_module(module_name)
     except ModuleNotFoundError:
-        # task class defined outside an importable package (e.g. a test file):
-        # load it from its source file, the moral equivalent of the
-        # reference's copy-script-into-tmp job materialization.
+        # task class defined outside an importable package (e.g. a test file
+        # or the user's driver script): load it from its source file, the
+        # moral equivalent of the reference's copy-script-into-tmp job
+        # materialization.  The module is loaded under a PRIVATE name —
+        # loading a driver script as "__main__" would satisfy its
+        # ``if __name__ == "__main__"`` guard and re-run the whole driver
+        # (destructive setup included) inside every worker.
         src_file = job_config.get("src_file")
         if not src_file:
             raise
-        spec = importlib.util.spec_from_file_location(module_name, src_file)
+        load_name = ("_ctt_worker_driver" if module_name == "__main__"
+                     else module_name)
+        spec = importlib.util.spec_from_file_location(load_name, src_file)
         module = importlib.util.module_from_spec(spec)
-        sys.modules[module_name] = module
+        sys.modules[load_name] = module
         spec.loader.exec_module(module)
     task_cls = getattr(module, class_name)
 
