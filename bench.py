@@ -118,12 +118,10 @@ def run_chain(store_path, shape, workdir, target, host_impl=False,
     impl = {"impl": "host"} if host_impl else {}
     ws_params = {"threshold": 0.4, "size_filter": 50}
     cfg.write_task_config("watershed", {**ws_params, **impl})
-    # hybrid: device runs EDT/filters/seeds/feature-stats, the host C++
-    # bucket-queue flood handles the (gather-bound, serial-friendly)
-    # priority flood — same flood algorithm as the CPU baseline, so the
-    # device<->CPU quality delta stays tight
-    cfg.write_task_config("fused_segmentation",
-                          {**ws_params, "ws_method": "hybrid"})
+    # resident device path: input volume uploaded once, per-block fused
+    # program (coarse-basins watershed + RAG + stats), RLE label
+    # downloads, in-RAM fragment staging for faces + final write
+    cfg.write_task_config("fused_segmentation", ws_params)
     cfg.write_task_config("initial_sub_graphs", impl)
     cfg.write_task_config("block_edge_features", impl)
     if max_jobs is None:
@@ -208,12 +206,14 @@ def task_profile(workdir):
 
 
 def metrics(seg, gt):
-    from cluster_tools_tpu.utils.validation import (cremi_score, rand_index,
-                                                    variation_of_information)
+    """All metrics from ONE streamed contingency table: three separate
+    full-volume table builds held multi-GB uint64 temporaries (the r3
+    bench peaked at 15 GB RSS largely here)."""
+    from cluster_tools_tpu.utils.validation import (ContingencyTable,
+                                                    cremi_score_from_table)
 
-    vs, vm = variation_of_information(seg, gt)
-    are, _ = rand_index(seg, gt)
-    cs = cremi_score(seg, gt)[-1]
+    table = ContingencyTable.from_arrays_chunked(gt, seg)
+    vs, vm, are, cs = cremi_score_from_table(table)
     return {"voi_split": round(float(vs), 4), "voi_merge": round(float(vm), 4),
             "rand_error": round(float(are), 4), "cremi": round(float(cs), 4)}
 
@@ -237,7 +237,7 @@ def main():
     write_store(cpu_store, bnd[cpu_crop])
     gt_path = os.path.join(base, "gt.npy")
     np.save(gt_path, lab)
-    lab_cpu = lab[cpu_crop].astype("uint64")
+    lab_cpu = lab[cpu_crop].copy()  # copy: a view would pin the full volume past `del lab`
     del lab, bnd  # chains stream from the store; keep RSS bounded
 
     n_voxels = int(np.prod(SHAPE))
@@ -259,7 +259,7 @@ def main():
     cpu_t, cpu_seg = run_cpu_chain_subprocess(cpu_store, CPU_SHAPE,
                                               os.path.join(base, "cpu"))
 
-    gt = np.load(gt_path).astype("uint64")
+    gt = np.load(gt_path)
     dev_m = metrics(dev_seg, gt)
     del gt, dev_seg
     cpu_m = metrics(cpu_seg, lab_cpu)
@@ -280,7 +280,7 @@ def main():
     assert voi_delta < 0.25, f"device<->cpu VOI delta too large: {voi_delta}"
     # memory stays bounded: streamed block windows, not volume-sized
     # device/host buffers (input volume alone is ~0.78 GB float32)
-    assert peak_rss_gb < 16.0, f"peak RSS {peak_rss_gb:.1f} GB unbounded?"
+    assert peak_rss_gb < 8.0, f"peak RSS {peak_rss_gb:.1f} GB unbounded?"
 
     value = n_voxels / dev_t
     baseline = n_cpu_voxels / cpu_t

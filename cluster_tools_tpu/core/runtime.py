@@ -472,9 +472,9 @@ class BlockTask(Task):
         from ..parallel import multihost as mh
 
         if mh.process_count() > 1:
-            return self._run_jobs_multiprocess(block_list,
-                                               task_specific_config,
-                                               n_jobs)
+            return self._run_jobs_multiprocess(
+                block_list, task_specific_config, n_jobs,
+                consecutive_blocks=consecutive_blocks)
         if block_list is None or self.global_task:
             n_jobs = 1
             job_blocks: List[Optional[List[int]]] = [
@@ -546,15 +546,22 @@ class BlockTask(Task):
                       consecutive_blocks=consecutive_blocks)
 
     def _run_jobs_multiprocess(self, block_list, task_specific_config,
-                               n_jobs: Optional[int] = None) -> None:
+                               n_jobs: Optional[int] = None,
+                               consecutive_blocks: bool = False) -> None:
         """Cooperative execution across SPMD processes (multi-host mode,
         parallel/multihost.py): blockwise tasks shard one job per process
-        round-robin; global tasks AND single-job tasks (n_jobs=1 callers
-        own cross-block state, e.g. the fused chain's running offsets) run
-        on the lead only.  Everyone meets at a filesystem barrier, then
-        every process verifies ALL job logs over the shared store — the
-        reference's many-nodes path (cluster_tasks.py:375-490) with
-        processes instead of sbatch."""
+        (round-robin or consecutive); global tasks AND single-job tasks
+        (n_jobs=1 callers own cross-block state, e.g. the fused chain's
+        running offsets) run on the lead only.  Everyone meets at a
+        filesystem barrier, then every process verifies ALL job logs over
+        the shared store — the reference's many-nodes path
+        (cluster_tasks.py:375-490) with processes instead of sbatch.
+
+        Block-granular retry works IN-RUN like the single-process path
+        (reference semantics, cluster_tasks.py:136-170): the shared logs
+        are the consensus channel — after the barrier every process
+        parses the SAME files, derives the SAME failed-block list, and
+        re-enters its shard of it; no extra coordination needed."""
         from ..parallel import multihost as mh
 
         pc, pid = mh.process_count(), mh.process_index()
@@ -568,7 +575,12 @@ class BlockTask(Task):
         else:
             block_list = list(block_list)
             n_jobs = pc
-            job_blocks = [block_list[j::pc] for j in range(pc)]
+            if consecutive_blocks:
+                per = (len(block_list) + pc - 1) // pc
+                job_blocks = [block_list[j * per:(j + 1) * per]
+                              for j in range(pc)]
+            else:
+                job_blocks = [block_list[j::pc] for j in range(pc)]
             my_jobs = [pid] if job_blocks[pid] else []
 
         import inspect
@@ -604,8 +616,34 @@ class BlockTask(Task):
                       [j for j in range(n_jobs) if job_blocks[j]])
         failed = [j for j in check_jobs
                   if not parse_job_success(self.log_path(j), j)]
+        # consensus point: nobody may act on the verdict (a retry
+        # OVERWRITES its job log with a success log) until every process
+        # has parsed the same pre-retry logs
+        mh.fs_barrier(self.tmp_folder, f"{self.name_with_id}_verdict")
         if failed:
-            self._fail([j for j in failed if j == pid] or failed)
+            retryable = (self.allow_retry and not global_job
+                         and self._retry_count < int(
+                             self.global_config.get("max_num_retries", 0))
+                         and len(failed) <= len(check_jobs) / 2)
+            if not retryable:
+                self._fail([j for j in failed if j == pid] or failed)
+            # consensus WITHOUT messages: every process parses the same
+            # shared logs (complete — everyone passed the jobs barrier)
+            # and derives the identical failed-block list and shards
+            processed: Set[int] = set()
+            for j in check_jobs:
+                if j in failed:
+                    processed |= parse_processed_blocks(self.log_path(j))
+                else:
+                    processed |= set(job_blocks[j] or [])
+            failed_blocks = [b for b in block_list if b not in processed]
+            self._retry_count += 1
+            log(f"{self.name_with_id}: multiprocess retry "
+                f"{self._retry_count} with {len(failed_blocks)} failed "
+                "blocks")
+            return self._run_jobs_multiprocess(
+                failed_blocks, task_specific_config, n_jobs,
+                consecutive_blocks=consecutive_blocks)
         if mh.is_lead():
             # single writer for the shared status file; its stages cover
             # the lead's own jobs (peers' inline stages stay local)

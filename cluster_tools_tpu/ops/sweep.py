@@ -70,9 +70,11 @@ def _lex_min(P1, lab1, P2, lab2):
 
 
 def _pack(climb, rsteps, tsteps):
+    # tsteps saturates one short of its field max: a fully saturated pack
+    # would otherwise equal the _INF unreachable sentinel exactly
     return ((jnp.minimum(climb, _CLIMB_MAX) << (RSTEP_BITS + TSTEP_BITS))
             | (jnp.minimum(rsteps, _RSTEP_MAX) << TSTEP_BITS)
-            | jnp.minimum(tsteps, _TSTEP_MAX))
+            | jnp.minimum(tsteps, _TSTEP_MAX - 1))
 
 
 def _transfer(P, C, t, L):
@@ -348,3 +350,52 @@ def rle_decode(starts: np.ndarray, values: np.ndarray, total: int) -> np.ndarray
     starts = np.asarray(starts, dtype=np.int64)
     lengths = np.diff(np.append(starts, total))
     return np.repeat(np.asarray(values), lengths)
+
+
+#: forced run break interval for the packed encoder: lengths must fit 16
+#: bits, so runs are split at this stride (adds ~n/stride extra runs)
+RLE_STRIDE = np.uint32(1 << 15)
+
+
+def rle_encode_packed(flat: jnp.ndarray, cap: int):
+    """Run-length encode label ids < 2^16 into ONE uint32 stream,
+    ``length << 16 | value`` per run (runs force-split every RLE_STRIDE
+    elements so lengths fit).  The host downloads the fixed-cap buffer
+    with a single transfer — no device-side prefix program that would
+    queue behind in-flight block programs — and decodes with one
+    ``np.repeat``.  Returns ``(packed uint32[cap], n_runs, ok)``; ok
+    is False on cap overflow OR ids >= 2^16 (caller falls back to a
+    dense download)."""
+    n = int(flat.shape[0])
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    brk = jnp.concatenate([jnp.ones((1,), bool),
+                           flat[1:] != flat[:-1]])
+    brk |= (idx % RLE_STRIDE) == 0
+    tgt = jnp.cumsum(brk.astype(jnp.int32)) - 1
+    n_runs = jnp.where(n > 0, tgt[-1] + 1, 0)
+    ok = (n_runs <= cap) & (flat.max() < (1 << 16))
+    tgt_c = jnp.where(brk & (tgt < cap), tgt, cap + 2)
+    starts = jnp.zeros((cap + 1,), jnp.uint32).at[tgt_c].set(
+        idx, mode="drop")[:cap]
+    values = jnp.zeros((cap + 1,), jnp.uint32).at[tgt_c].set(
+        flat.astype(jnp.uint32), mode="drop")[:cap]
+    run_pos = jnp.arange(cap, dtype=jnp.int32)
+    next_start = jnp.where(run_pos + 1 < n_runs,
+                           jnp.concatenate([starts[1:],
+                                            jnp.zeros((1,), jnp.uint32)]),
+                           jnp.uint32(n))
+    lengths = jnp.where(run_pos < n_runs, next_start - starts, 0)
+    packed = (lengths << 16) | (values & jnp.uint32(0xFFFF))
+    return packed, n_runs, ok
+
+
+def rle_decode_packed(packed: np.ndarray, n_runs: int,
+                      total: int) -> np.ndarray:
+    """Host-side inverse of :func:`rle_encode_packed`."""
+    arr = np.asarray(packed[:n_runs])
+    lengths = (arr >> 16).astype(np.int64)
+    values = (arr & 0xFFFF).astype(np.uint16)
+    out = np.repeat(values, lengths)
+    if out.size != total:
+        raise ValueError(f"RLE decode size {out.size} != {total}")
+    return out

@@ -409,6 +409,76 @@ def _basins_impl(height, seeds, mask, connectivity: int, max_rounds: int,
     return labels.reshape(shape), ok
 
 
+def _coarse_impl(height, seeds, min_size: int, refine_rounds: int):
+    """Jit-composable 2x-coarse basin watershed: mean-pool the height,
+    max-pool the seeds, run the descent-forest + saddle-merge solve
+    (`_basins_impl`) on the 8x-smaller grid — every gather/scatter/cumsum
+    primitive shrinks with it (measured 5.9 s -> ~0.6 s per
+    [58,576,576] block) — then upsample and snap boundaries back at full
+    resolution with ``refine_rounds`` steepest-descent adoption sweeps
+    (pure stencils).  Stays in the flood's divergence class (VI ~0.15 vs
+    the bucket-queue flood; scan-only formulations measured ~0.6,
+    ops/sweep.py).  Odd dims are edge-padded for the pooling and cropped
+    back.  ``min_size`` is in FULL-resolution voxels."""
+    from .components import _shifted
+
+    shape = height.shape
+    pads = tuple((0, s % 2) for s in shape)
+    if any(p[1] for p in pads):
+        height_p = jnp.pad(height, pads, mode="edge")
+        seeds_p = jnp.pad(seeds, pads)
+    else:
+        height_p, seeds_p = height, seeds
+    cshape = tuple(s // 2 for s in height_p.shape)
+    cn = int(np.prod(cshape))
+    hc = height_p.reshape(cshape[0], 2, cshape[1], 2,
+                          cshape[2], 2).mean((1, 3, 5))
+    sc = seeds_p.reshape(cshape[0], 2, cshape[1], 2,
+                         cshape[2], 2).max((1, 3, 5))
+    wsc, ok = _basins_impl(hc, sc, None, 1, 64, max(min_size // 8, 1),
+                           min(max(cn // 8, 4096), cn // 2 + 2),
+                           min(max(cn // 2, 16384), cn))
+    ws = jnp.repeat(jnp.repeat(jnp.repeat(wsc, 2, 0), 2, 1), 2, 2)
+    ws = ws[tuple(slice(0, s) for s in shape)]
+
+    big = jnp.float32(3.4e38)
+
+    def refine(w, _):
+        best_h, best_l = height, w
+        for off in ((1, 0, 0), (-1, 0, 0), (0, 1, 0),
+                    (0, -1, 0), (0, 0, 1), (0, 0, -1)):
+            nh = _shifted(height, off, big)
+            nl = _shifted(w, off, jnp.int32(0))
+            better = (nh < best_h) & (nl > 0)
+            best_h = jnp.where(better, nh, best_h)
+            best_l = jnp.where(better, nl, best_l)
+        return best_l, 0
+
+    ws, _ = jax.lax.scan(refine, ws, None, length=refine_rounds)
+    return ws, ok
+
+
+def seeded_watershed_coarse(height, seeds, mask=None, connectivity: int = 1,
+                            min_size: int = 0, refine_rounds: int = 3):
+    """Host-facing wrapper around :func:`_coarse_impl` (3d, maskless —
+    masked callers use the full-resolution methods)."""
+    if mask is not None:
+        raise ValueError("coarse watershed does not support masks; use "
+                         "method='basins'")
+    if connectivity != 1:
+        raise ValueError("coarse watershed refines along faces "
+                         "(connectivity=1)")
+    height = jnp.asarray(height).astype(jnp.float32)
+    labels, ok = _coarse_jit(height, jnp.asarray(seeds), int(min_size),
+                             int(refine_rounds))
+    return labels, bool(ok)
+
+
+@partial(jax.jit, static_argnames=("min_size", "refine_rounds"))
+def _coarse_jit(height, seeds, min_size: int, refine_rounds: int):
+    return _coarse_impl(height, seeds, min_size, refine_rounds)
+
+
 @partial(jax.jit, static_argnames=("connectivity", "method"))
 def _batched_impl(heights, seeds, masks, connectivity: int, method: str):
     def one(h, s, m):

@@ -10,13 +10,15 @@ chunk-aligned writes) and replaces the scheduler with SPMD processes:
 * every process runs the SAME driver script; ``jax.distributed.initialize``
   (or the ``CTT_PROCESS_COUNT``/``CTT_PROCESS_ID`` env pair for CPU smoke
   tests without a coordination service) tells each process who it is;
-* blockwise tasks shard their block list round-robin per process — process
-  p executes job p of an n_processes-job layout, so the job protocol and
-  the log-line success detection apply unchanged (core/runtime.py).
-  Block-granular RETRY is driver-rerun only in this mode: a failed job
-  fails the task on every process, and re-running the driver script
-  redoes the incomplete tasks (the single-process in-run retry loop would
-  need a cross-process consensus on the failed-block set);
+* blockwise tasks shard their block list per process — process p executes
+  job p of an n_processes-job layout, so the job protocol and the
+  log-line success detection apply unchanged (core/runtime.py).
+  Block-granular RETRY runs IN-RUN like the single-process path: the
+  shared job logs are the consensus channel — after the jobs barrier
+  every process parses the same complete logs, derives the identical
+  failed-block list, and re-enters its shard of it
+  (core/runtime.py _run_jobs_multiprocess; reference semantics
+  cluster_tasks.py:136-170);
 * global (reduce-style) tasks run on the LEAD process only; everyone else
   waits at a filesystem barrier and then reads the lead's results/logs —
   the reference's barrier-only synchronization, kept deliberately;
@@ -101,47 +103,102 @@ def owned_blocks(block_list: Sequence[int]) -> List[int]:
     return list(block_list)[process_index()::process_count()]
 
 
+#: this process instance's epoch id (fresh per process start) and the
+#: in-memory round counters, keyed by (run token, barrier name)
+_EPOCH_ID: Optional[str] = None
+_ROUNDS: dict = {}
+
+
+def _my_epoch(bdir: str) -> str:
+    """Publish (once) this process instance's epoch: a fresh uuid written
+    at the first barrier use.  The run token is derived from ALL
+    processes' epochs, so any process restart changes the token and
+    renamespaces every barrier — no clocks involved."""
+    global _EPOCH_ID
+    if _EPOCH_ID is None:
+        import uuid
+
+        _EPOCH_ID = uuid.uuid4().hex[:16]
+    path = os.path.join(bdir, f"epoch_p{process_index()}")
+    tmp = path + f".tmp{os.getpid()}"
+    if not os.path.exists(path) or open(path).read().strip() != _EPOCH_ID:
+        with open(tmp, "w") as f:
+            f.write(_EPOCH_ID)
+        os.replace(tmp, path)
+    return _EPOCH_ID
+
+
+def _current_token(bdir: str, pc: int) -> Optional[str]:
+    """Run token = digest of every process's current epoch (None until
+    all are published)."""
+    import hashlib
+
+    epochs = []
+    for p in range(pc):
+        try:
+            with open(os.path.join(bdir, f"epoch_p{p}")) as f:
+                e = f.read().strip()
+        except FileNotFoundError:
+            return None
+        if not e:
+            return None
+        epochs.append(e)
+    return hashlib.sha1("|".join(epochs).encode()).hexdigest()[:12]
+
+
 def fs_barrier(tmp_folder: str, name: str, timeout: float = 600.0,
                poll: float = 0.05) -> None:
     """Filesystem barrier over the shared tmp folder (the reference's
     control plane is exactly files + polling; cluster_tasks.py:466-490).
 
-    COUNTER-based so reruns stay correct: each process persists a per-
-    barrier round counter, increments it on entry, and waits until every
-    process's counter reaches its own round — stale sentinels from a
-    previous (crashed or completed) run can never satisfy a new round, and
-    every process passes the same barriers in the same DAG order."""
+    Counters are IN-MEMORY, namespaced by a run token derived from every
+    participant's per-instance epoch uuid: a crashed run's on-disk state
+    can never satisfy (or stall) a fresh run, and if a peer restarts
+    MID-WAIT the token changes for everyone, all waiters re-enter the
+    new namespace at round 1, and the barrier converges — self-healing
+    without clocks or a coordinator."""
     pc = process_count()
     if pc <= 1:
         return
-    bdir = os.path.join(tmp_folder, "barriers", name)
+    bdir = os.path.join(tmp_folder, "barriers")
     os.makedirs(bdir, exist_ok=True)
-    mine = os.path.join(bdir, f"p{process_index()}.count")
-    prev = 0
-    if os.path.exists(mine):
-        with open(mine) as f:
-            prev = int(f.read().strip() or 0)
-    my_round = prev + 1
-    tmp = mine + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(str(my_round))
-    os.replace(tmp, mine)
+    _my_epoch(bdir)
+    entered_round: dict = {}
+
+    def _enter(token: str) -> int:
+        if token in entered_round:
+            return entered_round[token]
+        my_round = _ROUNDS.get((token, name), 0) + 1
+        _ROUNDS[(token, name)] = my_round
+        entered_round[token] = my_round
+        ndir = os.path.join(bdir, token, name)
+        os.makedirs(ndir, exist_ok=True)
+        mine = os.path.join(ndir, f"p{process_index()}.count")
+        tmp = mine + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(my_round))
+        os.replace(tmp, mine)
+        return my_round
+
     deadline = time.time() + timeout
     while True:
-        counts = []
-        for p in range(pc):
-            path = os.path.join(bdir, f"p{p}.count")
-            try:
-                with open(path) as f:
-                    counts.append(int(f.read().strip() or 0))
-            except (FileNotFoundError, ValueError):
-                counts.append(0)
-        if all(c >= my_round for c in counts):
-            return
+        token = _current_token(bdir, pc)
+        if token is not None:
+            my_round = _enter(token)
+            ndir = os.path.join(bdir, token, name)
+            counts = []
+            for p in range(pc):
+                try:
+                    with open(os.path.join(ndir, f"p{p}.count")) as f:
+                        counts.append(int(f.read().strip() or 0))
+                except (FileNotFoundError, ValueError):
+                    counts.append(0)
+            if all(c >= my_round for c in counts):
+                return
         if time.time() > deadline:
             raise TimeoutError(
-                f"barrier {name}: rounds {counts} < {my_round} after "
-                f"{timeout}s")
+                f"barrier {name}: not all {pc} processes arrived within "
+                f"{timeout}s (token {token})")
         time.sleep(poll)
 
 

@@ -72,6 +72,40 @@ class ContingencyTable:
                                       return_counts=True)
         return cls(p_ids, counts.astype("float64"))
 
+    @classmethod
+    def from_arrays_chunked(cls, seg_a, seg_b,
+                            chunk: int = 1 << 24) -> "ContingencyTable":
+        """Streaming variant of :func:`from_arrays`: the inputs are
+        consumed in flat chunks (cast per chunk — callers can keep their
+        narrow dtypes), so peak memory is O(chunk + unique pairs) instead
+        of several full-volume uint64 temporaries.  Labels must fit 32
+        bits (use from_arrays for the >4G-label edge case)."""
+        a = np.asarray(seg_a).reshape(-1)
+        b = np.asarray(seg_b).reshape(-1)
+        if a.shape != b.shape:
+            raise ValueError("segmentations must have the same size")
+        keys_parts = []
+        counts_parts = []
+        for lo in range(0, a.size, chunk):
+            aa = a[lo:lo + chunk].astype("uint64")
+            bb = b[lo:lo + chunk].astype("uint64")
+            if aa.size and (aa.max() >= 2 ** 32 or bb.max() >= 2 ** 32):
+                raise ValueError("labels exceed 32 bits; use from_arrays")
+            key = (aa << np.uint64(32)) | bb
+            uniq, cnt = np.unique(key, return_counts=True)
+            keys_parts.append(uniq)
+            counts_parts.append(cnt.astype("float64"))
+        if not keys_parts:
+            return cls(np.zeros((0, 2), "uint64"), np.zeros(0, "float64"))
+        keys = np.concatenate(keys_parts)
+        cnts = np.concatenate(counts_parts)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        counts = np.zeros(len(uniq), "float64")
+        np.add.at(counts, inv, cnts)
+        p_ids = np.stack([uniq >> np.uint64(32),
+                          uniq & np.uint64(0xFFFFFFFF)], axis=1)
+        return cls(p_ids, counts)
+
     def drop_pairs(self, mask: np.ndarray) -> "ContingencyTable":
         keep = ~np.asarray(mask, bool)
         return ContingencyTable(self.p_ids[keep], self.p_counts[keep])

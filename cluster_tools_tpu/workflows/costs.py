@@ -29,27 +29,19 @@ def transform_probabilities_to_costs(probs: np.ndarray, beta: float = 0.5,
 
     cost = log((1-p)/p) + log((1-beta)/beta), p clipped to [.001, .999];
     optionally scaled by (size/max_size)**exponent (reference semantics,
-    probs_to_costs.py:115-131).  Runs as one jitted device program.
+    probs_to_costs.py:115-131).  Plain numpy: the edge table is a few
+    hundred thousand floats — a device round trip (let alone a per-call
+    jit trace) costs orders of magnitude more than the transform.
     """
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def _kernel(p, sizes):
-        p_min = 0.001
-        p = (1.0 - 2 * p_min) * p + p_min
-        c = jnp.log((1.0 - p) / p) + float(np.log((1.0 - beta) / beta))
-        if sizes is not None:
-            w = sizes / sizes.max()
-            if weighting_exponent != 1.0:
-                w = w ** weighting_exponent
-            c = c * w
-        return c
-
-    if edge_sizes is None:
-        return np.asarray(_kernel(probs.astype("float32"), None))
-    return np.asarray(_kernel(probs.astype("float32"),
-                              edge_sizes.astype("float32")))
+    p_min = 0.001
+    p = (1.0 - 2 * p_min) * probs.astype("float32") + p_min
+    c = np.log((1.0 - p) / p) + float(np.log((1.0 - beta) / beta))
+    if edge_sizes is not None:
+        w = edge_sizes.astype("float32") / max(float(edge_sizes.max()), 1e-6)
+        if weighting_exponent != 1.0:
+            w = w ** weighting_exponent
+        c = c * w
+    return c.astype("float32")
 
 
 def apply_node_labels(costs: np.ndarray, uv_ids: np.ndarray, mode: str,

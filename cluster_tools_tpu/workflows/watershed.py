@@ -66,6 +66,36 @@ def _read_input(ds, bb, cfg) -> np.ndarray:
     return _normalize_input(data, cfg)
 
 
+def reflect_indices(start: int, stop: int, n: int) -> np.ndarray:
+    """Volume-level reflection indices for ``range(start, stop)`` over an
+    axis of length n: out-of-volume positions fold back as the mirror of
+    the WHOLE axis (period 2n-2), so every reader of a block's outer
+    window — per-block store reads and resident-volume slicing alike —
+    sees identical phantom content (reflecting only the clipped block
+    read would make the phantom depend on the block's clip)."""
+    idx = np.arange(start, stop)
+    if n == 1:
+        return np.zeros_like(idx)
+    period = 2 * n - 2
+    j = np.mod(idx, period)
+    return np.where(j < n, j, period - j)
+
+
+def read_outer_reflect(ds, begin, block_shape, halo) -> np.ndarray:
+    """Read ``[begin-halo, begin+block_shape+halo)`` with out-of-volume
+    parts filled by volume-level reflection (see reflect_indices)."""
+    shape = ds.shape[-len(begin):]
+    ridx = [reflect_indices(b - h, b + bs + h, n)
+            for b, h, bs, n in zip(begin, halo, block_shape, shape)]
+    los = [int(r.min()) for r in ridx]
+    his = [int(r.max()) + 1 for r in ridx]
+    data = np.asarray(ds[tuple(slice(lo, hi) for lo, hi in zip(los, his))])
+    if all(len(r) == hi - lo and (np.diff(r) == 1).all()
+           for r, lo, hi in zip(ridx, los, his)):
+        return data  # interior block: contiguous read, no gather
+    return data[np.ix_(*[r - lo for r, lo in zip(ridx, los)])]
+
+
 def _read_padded_input(ds, block, cfg, halo, raw: bool = False) -> np.ndarray:
     """Read the block at the uniform outer shape (reflect-padded at volume
     borders), same normalization policy as _read_input.  ``raw=True`` skips
@@ -79,7 +109,7 @@ def _read_padded_input(ds, block, cfg, halo, raw: bool = False) -> np.ndarray:
             ds, block.begin, cfg["block_shape"], halo,
             channel_slice=_channel_slice(ds, cfg)).astype("float32")
     else:
-        data = load_with_halo(ds, block.begin, cfg["block_shape"], halo)
+        data = read_outer_reflect(ds, block.begin, cfg["block_shape"], halo)
         # the device pipeline always divides uint8 by 255, so the raw path
         # is only taken when that matches _normalize_input's data-dependent
         # rule (max > 1); degenerate {0,1} blocks go through the host rule
@@ -206,7 +236,25 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
             seeds = connected_components(maxima,
                                          connectivity=len(data.shape),
                                          method="propagation")
-        ws = np.array(seeded_watershed(height, seeds, jmask, connectivity=1))
+        method = _ws_algorithm(cfg)
+        if method == "coarse" and jmask is None and data.ndim == 3:
+            # shared watershed core with the fused pipeline
+            # (workflows/fused_pipeline._resident_program): identical
+            # composition -> identical fragment partitions, and the size
+            # filter is integrated in the coarse solve
+            from ..ops.watershed import seeded_watershed_coarse
+
+            labels, ok = seeded_watershed_coarse(
+                height, seeds, min_size=min_size or 0,
+                refine_rounds=int(cfg.get("refine_rounds", 3)))
+            if ok:
+                return np.array(labels).astype("uint64")
+            ws = np.array(seeded_watershed(height, seeds, jmask,
+                                           connectivity=1))
+        else:
+            ws = np.array(seeded_watershed(
+                height, seeds, jmask, connectivity=1,
+                method=None if method == "coarse" else method))
     if min_size:
         ws = size_filter(ws, np.asarray(height), min_size,
                          mask=None if mask is None else mask.astype(bool),
@@ -298,9 +346,12 @@ def iter_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
     # net loss on the CPU backend — there the host size filter is faster.
     # cfg["fuse_size_filter"] overrides the backend default (tests force
     # both paths on the CPU mesh).
+    algo = _ws_algorithm(cfg)
     fuse_filter = cfg.get("fuse_size_filter")
     if fuse_filter is None:
         fuse_filter = jax.default_backend() != "cpu"
+    if algo == "coarse":
+        fuse_filter = True  # integrated in the coarse solve
     pipeline = _ws_pipeline_3d(
         float(cfg.get("threshold", 0.25)),
         float(cfg.get("sigma_seeds", 2.0)),
@@ -308,8 +359,7 @@ def iter_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
         float(cfg.get("alpha", 0.8)),
         min_size if fuse_filter else 0,
         return_height=not fuse_filter and bool(min_size),
-        ws_method=cfg.get("ws_method") or os.environ.get("CTT_WS_METHOD",
-                                                         "basins"))
+        ws_method=algo, refine_rounds=int(cfg.get("refine_rounds", 3)))
 
     def submit(b):
         return b, pipeline(jnp.asarray(b))
@@ -350,7 +400,8 @@ def run_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
 @lru_cache(maxsize=8)
 def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
                     sigma_weights: float, alpha: float, min_size: int = 0,
-                    return_height: bool = False, ws_method: str = "basins"):
+                    return_height: bool = False, ws_method: str = "basins",
+                    refine_rounds: int = 3):
     """Cached fused jitted pipeline — one compile per parameter set (the
     jit cache lives on the returned function, so re-creating the closure per
     call would recompile every time).  With ``min_size`` the size filter is
@@ -380,7 +431,14 @@ def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
         maxima = local_maxima(dt_smooth, radius=2) & fg
         seeds = connected_components(maxima, connectivity=3,
                                      method="propagation")
-        if ws_method == "basins":
+        if ws_method == "coarse":
+            # shared watershed core with the fused pipeline
+            # (workflows/fused_pipeline._resident_program) — identical
+            # composition, size filter integrated
+            from ..ops.watershed import _coarse_impl
+
+            ws, ok = _coarse_impl(height, seeds, min_size, refine_rounds)
+        elif ws_method == "basins":
             # the basin formulation fuses the size filter: small fragments
             # are stripped and re-merged in ~2 extra cheap rounds instead
             # of a full second watershed pass.  Tight capacities for speed;
@@ -410,6 +468,16 @@ def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
         return ws, ok
 
     return pipeline
+
+
+def _ws_algorithm(cfg) -> str:
+    """Resolve the watershed ALGORITHM ('coarse'/'basins'/'flood') from
+    task config or the CTT_WS_METHOD env; distinct from the fused task's
+    execution-strategy ws_method (device/hybrid/legacy), whose values
+    fall through to the default."""
+    m = (cfg.get("ws_algorithm") or cfg.get("ws_method")
+         or os.environ.get("CTT_WS_METHOD", "coarse"))
+    return m if m in ("coarse", "basins", "flood") else "coarse"
 
 
 def run_ws_block_seeded(data: np.ndarray, cfg: Dict[str, Any],
@@ -630,9 +698,12 @@ class WatershedTask(BlockTask):
             mesh = blocks_mesh(n_dev)
             sharding = NamedSharding(mesh, P("blocks"))
             min_size = int(cfg.get("size_filter", 25) or 0)
+            algo = _ws_algorithm(cfg)
             fuse_filter = cfg.get("fuse_size_filter")
             if fuse_filter is None:
                 fuse_filter = jax.default_backend() != "cpu"
+            if algo == "coarse":
+                fuse_filter = True  # integrated in the coarse solve
             pipeline = _ws_pipeline_3d(
                 float(cfg.get("threshold", 0.25)),
                 float(cfg.get("sigma_seeds", 2.0)),
@@ -640,8 +711,8 @@ class WatershedTask(BlockTask):
                 float(cfg.get("alpha", 0.8)),
                 min_size if fuse_filter else 0,
                 return_height=not fuse_filter and bool(min_size),
-                ws_method=cfg.get("ws_method")
-                or os.environ.get("CTT_WS_METHOD", "basins"))
+                ws_method=algo,
+                refine_rounds=int(cfg.get("refine_rounds", 3)))
             batched = jax.jit(jax.vmap(pipeline))
 
             block_ids = list(job_config["block_list"])

@@ -103,8 +103,10 @@ class WriteAssignments(BlockTask):
         block_shape = block_shape[-ndim:] if len(block_shape) >= ndim else block_shape
         chunks = self.task_config.get("chunks") or block_shape
         with file_reader(self.output_path) as f:
+            # segmentations compress ~100x at gzip-1; write time drops
+            # below the assignment-mapping cost
             f.require_dataset(self.output_key, shape=shape, chunks=chunks,
-                              dtype="uint64")
+                              dtype="uint64", compression="gzip")
         block_list = self.blocks_in_volume(shape, block_shape)
         self.run_jobs(block_list, {
             "input_path": self.input_path, "input_key": self.input_key,
@@ -119,9 +121,16 @@ class WriteAssignments(BlockTask):
         max_id = int(table[:, 1].max()) if table.ndim == 2 else int(table.max())
         with file_reader(self.output_path) as f:
             f[self.output_key].attrs["maxId"] = max_id
+        # the write is the terminal consumer of the fused chain's in-RAM
+        # staging; release it so long-lived drivers don't pin the volume
+        from .fused_pipeline import clear_caches
+
+        clear_caches()
 
     @classmethod
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from ..core.runtime import stage
+
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
         table = load_assignments(cfg["assignment_path"], cfg.get("assignment_key"))
@@ -136,9 +145,24 @@ class WriteAssignments(BlockTask):
         ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
         for block_id in job_config["block_list"]:
             bb = blocking.get_block(block_id).bb
-            seg = ds_in[bb].astype("uint64")
+            # the fused pass stages fragments in RAM (same process) — no
+            # store re-read on the flagship path (r3: 25.7 s of the bench)
+            from .fused_pipeline import fragment_cache_get
+
+            ent = fragment_cache_get(cfg["input_path"], cfg["input_key"],
+                                     block_id)
+            if ent is not None:
+                local, f_off, _ = ent
+                seg = local.astype("uint64")
+                seg[seg > 0] += np.uint64(f_off)
+            else:
+                with stage("store-read"):
+                    seg = ds_in[bb].astype("uint64")
             if offsets is not None:
                 off = np.uint64(offsets[block_id])
                 seg[seg != 0] += off
-            ds_out[bb] = apply_assignment_table(seg, table)
+            with stage("host-map"):
+                out = apply_assignment_table(seg, table)
+            with stage("store-write"):
+                ds_out[bb] = out
             log_fn(f"processed block {block_id}")

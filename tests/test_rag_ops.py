@@ -72,3 +72,32 @@ def test_compaction_capacity_overflow_raises():
     uv_o, feats_o = _oracle(u, v, x, ok)
     np.testing.assert_array_equal(uv, uv_o)
     np.testing.assert_allclose(feats, feats_o, rtol=1e-4, atol=1e-5)
+
+
+def test_hist_stats_match_sort_stats():
+    """The 256-bin histogram formulation must reproduce the sorted-position
+    statistics exactly for uint8 samples (same mean/var/min/quantiles/max,
+    same edge order)."""
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.ops.rag import (_edge_stats_device,
+                                           _edge_stats_hist_device)
+
+    rng = np.random.RandomState(0)
+    n = 4096
+    u = rng.randint(1, 40, n).astype("int32")
+    v = u + rng.randint(1, 10, n).astype("int32")
+    raw = rng.randint(0, 256, n).astype("uint8")
+    ok = rng.rand(n) < 0.8
+    uv_s, feats_s, n_s, of_s = _edge_stats_device(
+        jnp.asarray(u), jnp.asarray(v),
+        jnp.asarray(raw.astype("float32") / 255.0), jnp.asarray(ok),
+        e_max=1024)
+    uv_h, feats_h, n_h, of_h = _edge_stats_hist_device(
+        jnp.asarray(u), jnp.asarray(v), jnp.asarray(raw), jnp.asarray(ok),
+        e_max=1024)
+    assert int(n_s) == int(n_h) and int(of_s) == int(of_h) == 0
+    nr = int(n_s)
+    np.testing.assert_array_equal(np.asarray(uv_s)[:nr], np.asarray(uv_h)[:nr])
+    np.testing.assert_allclose(np.asarray(feats_h)[:nr],
+                               np.asarray(feats_s)[:nr], rtol=2e-4, atol=2e-6)

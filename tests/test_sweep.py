@@ -167,3 +167,27 @@ class TestRLE:
         assert int(k) == 2
         np.testing.assert_array_equal(np.asarray(dense),
                                       [[0, 1, 1], [2, 0, 1]])
+
+
+class TestPackedRLE:
+    def test_roundtrip(self):
+        from cluster_tools_tpu.ops.sweep import (rle_decode_packed,
+                                                 rle_encode_packed)
+
+        rng = np.random.RandomState(0)
+        x = np.repeat(rng.randint(0, 500, 300).astype("int32"),
+                      rng.randint(1, 60000, 300))
+        packed, n, ok = rle_encode_packed(jnp.asarray(x), 1 << 16)
+        assert bool(ok)
+        dec = rle_decode_packed(np.asarray(packed), int(n), len(x))
+        np.testing.assert_array_equal(dec, x.astype("uint16"))
+
+    def test_overflow_and_id_range(self):
+        from cluster_tools_tpu.ops.sweep import rle_encode_packed
+
+        x = np.arange(100, dtype=np.int32)
+        *_, ok = rle_encode_packed(jnp.asarray(x), 10)
+        assert not bool(ok)
+        big = np.full(10, 1 << 16, np.int32)
+        *_, ok = rle_encode_packed(jnp.asarray(big), 64)
+        assert not bool(ok)
