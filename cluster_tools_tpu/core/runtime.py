@@ -614,11 +614,22 @@ class BlockTask(Task):
 
         check_jobs = ([0] if global_job else
                       [j for j in range(n_jobs) if job_blocks[j]])
+        # consensus WITHOUT messages: every process parses the same shared
+        # logs (complete — everyone passed the jobs barrier) and derives
+        # the identical verdict and failed-block list.  ALL parsing must
+        # happen BEFORE the verdict barrier: a fast peer's retry
+        # OVERWRITES its job log with a success log, and a slow peer
+        # parsing it late would derive a different (smaller) failed-block
+        # list — its shard assignment would then silently drop blocks
         failed = [j for j in check_jobs
                   if not parse_job_success(self.log_path(j), j)]
-        # consensus point: nobody may act on the verdict (a retry
-        # OVERWRITES its job log with a success log) until every process
-        # has parsed the same pre-retry logs
+        processed: Set[int] = set()
+        if failed and not global_job:
+            for j in check_jobs:
+                if j in failed:
+                    processed |= parse_processed_blocks(self.log_path(j))
+                else:
+                    processed |= set(job_blocks[j] or [])
         mh.fs_barrier(self.tmp_folder, f"{self.name_with_id}_verdict")
         if failed:
             retryable = (self.allow_retry and not global_job
@@ -627,15 +638,6 @@ class BlockTask(Task):
                          and len(failed) <= len(check_jobs) / 2)
             if not retryable:
                 self._fail([j for j in failed if j == pid] or failed)
-            # consensus WITHOUT messages: every process parses the same
-            # shared logs (complete — everyone passed the jobs barrier)
-            # and derives the identical failed-block list and shards
-            processed: Set[int] = set()
-            for j in check_jobs:
-                if j in failed:
-                    processed |= parse_processed_blocks(self.log_path(j))
-                else:
-                    processed |= set(job_blocks[j] or [])
             failed_blocks = [b for b in block_list if b not in processed]
             self._retry_count += 1
             log(f"{self.name_with_id}: multiprocess retry "

@@ -153,10 +153,16 @@ def fs_barrier(tmp_folder: str, name: str, timeout: float = 600.0,
 
     Counters are IN-MEMORY, namespaced by a run token derived from every
     participant's per-instance epoch uuid: a crashed run's on-disk state
-    can never satisfy (or stall) a fresh run, and if a peer restarts
-    MID-WAIT the token changes for everyone, all waiters re-enter the
-    new namespace at round 1, and the barrier converges — self-healing
-    without clocks or a coordinator."""
+    can never satisfy (or stall) a fresh run (the original failure mode:
+    a survivor one barrier-round ahead of a restarted peer stalls to the
+    timeout).  If a peer restarts while others WAIT at a barrier, the
+    token change makes the waiters re-enter the new namespace and
+    converge with the restarted peer; peers that already PASSED the
+    barrier do not re-enter it, so full recovery still requires the
+    restarted run to reach the same barrier through the (idempotent,
+    target-skipping) DAG — the reference needs the same driver rerun for
+    a lost node (its analog: cluster_tasks.py polling a dead job
+    forever)."""
     pc = process_count()
     if pc <= 1:
         return

@@ -239,7 +239,8 @@ def _hybrid_stats_program(outer_shape, halo, e_max: int):
 def _resident_program(outer_shape, halo, in_dtype, threshold: float,
                       sigma_seeds: float, sigma_weights: float, alpha: float,
                       min_size: int, e_max: int, rle_cap: int,
-                      refine_rounds: int, pair_cap: int = 1 << 22):
+                      refine_rounds: int, pair_cap: int = 1 << 22,
+                      batched: bool = False):
     """The round-4 flagship per-block program, compiled once against a
     DEVICE-RESIDENT padded volume: dynamic-slice the outer block, run the
     full chain (normalize -> EDT -> filters -> seeds -> watershed ->
@@ -274,7 +275,6 @@ def _resident_program(outer_shape, halo, in_dtype, threshold: float,
     n_outer = int(np.prod(outer_shape))
     is_u8 = np.dtype(in_dtype) == np.uint8
 
-    @jax.jit
     def run(vol, origin_extent):
         # one packed int32[6] per block: [origin, clipped extent] — a
         # single tiny upload per call (each arg upload is its own RPC on
@@ -347,7 +347,11 @@ def _resident_program(outer_shape, halo, in_dtype, threshold: float,
         return (meta, uv, feats.astype(jnp.float32), packed_lo, packed_hi,
                 dense_grid.astype(jnp.uint16), dense_grid)
 
-    return run
+    if batched:
+        # mesh rounds: one block per device — the volume is replicated,
+        # the per-block args shard over the leading axis
+        return jax.jit(jax.vmap(run, in_axes=(None, 0)))
+    return jax.jit(run)
 
 
 def _host_block_fallback(data, cfg, halo, block):
@@ -563,8 +567,18 @@ class FusedSegmentationBlocks(BlockTask):
 
         with stage("store-read"):
             vol = ds_in[...]
-        is_u8 = (vol.dtype == np.uint8 and vol.max() > 1
+        mx = float(vol.max()) if vol.size else 0.0
+        is_u8 = (vol.dtype == np.uint8 and mx > 1
                  and not cfg.get("invert_inputs", False))
+        # record the volume-level normalization so face assembly in OTHER
+        # processes (cache misses) puts face samples on the same scale as
+        # the interior samples (a thin plane's own max is not the volume's)
+        scale = 255.0 if (mx > 1.0 and mx <= 255) else (mx if mx > 1.0
+                                                        else 1.0)
+        with open(os.path.join(tmp_folder, "fused_input_scale.json"),
+                  "w") as fo:
+            json.dump({"scale": scale,
+                       "invert": bool(cfg.get("invert_inputs", False))}, fo)
         if not is_u8:
             vol = _normalize_input(vol.astype("float32"), cfg)
         _RAW_CACHE[(os.path.abspath(cfg["input_path"]),
@@ -688,10 +702,53 @@ class FusedSegmentationBlocks(BlockTask):
 
         write_futures: List = []
         with ThreadPoolExecutor(1) as writer:
-            for _ in stream_window(list(job_config["block_list"]), submit,
-                                   drain,
-                                   window=int(cfg.get("stream_window", 3))):
-                pass
+            if job_config.get("target") == "mesh":
+                # SPMD rounds over the device mesh: n_devices consecutive
+                # blocks shard one-per-device through the vmapped program
+                # (the reference's one-job-per-node fan-out,
+                # cluster_tasks.py:447-490); the drain then consumes each
+                # block IN ORDER, so offsets and staging are identical to
+                # the streamed path
+                import jax
+                from jax.sharding import (NamedSharding,
+                                          PartitionSpec as P)
+
+                from ..parallel.mesh import blocks_mesh
+
+                n_dev = len(jax.devices())
+                mesh = blocks_mesh(n_dev)
+                shard = NamedSharding(mesh, P("blocks"))
+                repl = NamedSharding(mesh, P(*([None] * vol_dev.ndim)))
+                vol_mesh = jax.device_put(vol_dev, repl)
+                batched = _resident_program(*prog_args, batched=True)
+                block_ids = list(job_config["block_list"])
+                rounds = [block_ids[r0:r0 + n_dev]
+                          for r0 in range(0, len(block_ids), n_dev)]
+
+                def _submit_round(round_ids):
+                    oe = np.stack(
+                        [np.asarray(_origin_extent(
+                            blocking.get_block(b))) for b in round_ids]
+                        + [np.zeros(6, "int32")]
+                        * (n_dev - len(round_ids)))
+                    return batched(
+                        vol_mesh, jax.device_put(jnp.asarray(oe), shard))
+
+                # one-round lookahead: devices compute round r+1 while
+                # the host drains round r (async dispatch)
+                pending = None
+                for ri, round_ids in enumerate(rounds):
+                    handles = pending or _submit_round(round_ids)
+                    pending = (_submit_round(rounds[ri + 1])
+                               if ri + 1 < len(rounds) else None)
+                    for j, bid in enumerate(round_ids):
+                        drain((bid, tuple(h[j] for h in handles)))
+            else:
+                for _ in stream_window(list(job_config["block_list"]),
+                                       submit, drain,
+                                       window=int(cfg.get("stream_window",
+                                                          3))):
+                    pass
             for fut in write_futures:
                 fut.result()  # surface any store-write failure
 
@@ -881,10 +938,18 @@ class FusedFaceAssembly(BlockTask):
                 return (x / 255.0 if is_u8 else x).ravel()
             with stage("store-read"):
                 x = np.asarray(ds_in[bb])
+            sidecar = os.path.join(cfg["fused_tmp"],
+                                   "fused_input_scale.json")
+            if os.path.exists(sidecar):
+                # volume-level normalization recorded by the fused pass
+                # (a thin plane's own max is NOT the volume's scale)
+                with open(sidecar) as f:
+                    sc = json.load(f)
+                x = x.astype("float64") / float(sc["scale"])
+                if sc.get("invert"):
+                    x = 1.0 - x
+                return x.ravel()
             if np.issubdtype(x.dtype, np.integer):
-                # dtype-level scale (NOT the thin plane's own max — the
-                # data-dependent rule would put face samples on a
-                # different scale than the interior block reads)
                 x = x.astype("float64") / float(np.iinfo(x.dtype).max)
                 if cfg.get("invert_inputs", False):
                     x = 1.0 - x

@@ -366,8 +366,11 @@ def iter_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
 
     def _fallback(b):
         # capacity overflow (pathological height field): redo this block
-        # through the always-correct per-block path
-        return run_ws_block(as_normalized_float(b), cfg)
+        # through the always-correct per-block path — forcing the
+        # exact-capacity basins algorithm (re-running the coarse solve
+        # that just overflowed would waste a full device pass)
+        return run_ws_block(as_normalized_float(b),
+                            {**cfg, "ws_algorithm": "basins"})
 
     def drain(entry):
         b, handles = entry
@@ -746,8 +749,9 @@ class WatershedTask(BlockTask):
                 for k, bid in enumerate(pending_ids):
                     if not oks[k]:
                         # capacity overflow: always-correct per-block redo
+                        # (basins forced: the coarse solve just overflowed)
                         ws = run_ws_block(as_normalized_float(pending[k]),
-                                          cfg)
+                                          {**cfg, "ws_algorithm": "basins"})
                     else:
                         ws = ws_all[k]
                         if heights is not None:
