@@ -208,9 +208,13 @@ class MeshBlockComponents(BlockTask):
                 max_ids[bid] = nonzero.size
                 luts[bid] = nonzero
                 # the device count must agree with the host compaction —
-                # the on-device scan IS the offsets source of truth
-                assert int(counts[i]) == nonzero.size, (bid, counts[i],
-                                                        nonzero.size)
+                # the on-device scan IS the offsets source of truth.
+                # A real exception, not an assert: python -O would strip
+                # the only guard reconciling scan offsets with compaction
+                if int(counts[i]) != nonzero.size:
+                    raise RuntimeError(
+                        f"block {bid}: device label count {int(counts[i])}"
+                        f" != host compaction {nonzero.size}")
                 offsets[bid] = round_base + np.uint64(int(offsets_dev[i]))
                 log_fn(f"processed block {bid}")
             round_base += np.uint64(int(counts[:len(round_ids)].sum()))
@@ -239,7 +243,11 @@ class MeshBlockComponents(BlockTask):
         check = np.zeros(blocking.n_blocks, dtype="uint64")
         np.cumsum(max_ids[:-1], out=check[1:])
         processed = np.asarray(block_list)
-        assert (offsets[processed] == check[processed]).all()
+        if not (offsets[processed] == check[processed]).all():
+            bad = processed[offsets[processed] != check[processed]][:5]
+            raise RuntimeError(
+                "device offset scan diverged from the reference cumsum "
+                f"at blocks {bad.tolist()}")
 
         for a, b, pa, pb in staged:
             fg = (pa > 0) & (pb > 0)

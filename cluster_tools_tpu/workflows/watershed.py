@@ -264,19 +264,29 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
 
 def run_ws_block_host(data: np.ndarray, cfg: Dict[str, Any],
                       mask: Optional[np.ndarray] = None) -> np.ndarray:
-    """Reference-faithful per-block DT watershed on HOST scipy C kernels.
+    """Per-block DT watershed on HOST scipy C kernels — the CPU analog of
+    the device pipeline, built from the reference's kernel family.
 
-    The vigra-analog CPU path (C implementations stand in one-for-one:
-    scipy distance_transform_edt for vigra distanceTransform,
-    gaussian_filter for gaussianSmoothing, maximum_filter for
-    localMaxima3D, label for labelVolumeWithBackground, and the native
-    C++ bucket-queue priority flood for watershedsNew — scipy's own
-    watershed_ift ignores its cost image in current scipy and is unusable;
-    reference: watershed/watershed.py:139-249).  Selected by task config
-    ``impl: 'host'`` — this is the measured stand-in for the reference's
+    C implementations stand in one-for-one: scipy distance_transform_edt
+    for vigra distanceTransform, gaussian_filter for gaussianSmoothing,
+    maximum_filter for localMaxima3D, label for
+    labelVolumeWithBackground, and the native C++ bucket-queue priority
+    flood for watershedsNew (scipy's own watershed_ift ignores its cost
+    image in current scipy and is unusable; reference:
+    watershed/watershed.py:139-249).  Selected by task config
+    ``impl: 'host'`` — the measured stand-in for the reference's
     ``target='local'`` per-block compute in the benchmark baseline
-    (vigra/nifty are not installable here), and a working CPU fallback for
-    machines without an accelerator."""
+    (vigra/nifty are not installable here), and a working CPU fallback
+    for machines without an accelerator.
+
+    Composition notes (kept IDENTICAL to this framework's device
+    pipeline so the bench's device<->CPU quality delta isolates the
+    watershed implementation, at the cost of three deviations from the
+    reference's defaults): the boundary map is smoothed BEFORE blending
+    with the inverted DT (the reference's _make_hmap smooths the blended
+    map, watershed.py:163-170), seed maxima use a 5x5x5 window (vigra
+    localMaxima3D is 3x3x3), and DT/WS run in 3d (the reference defaults
+    apply_dt_2d/apply_ws_2d to true for anisotropic stacks)."""
     from scipy import ndimage
 
     from ..native import seeded_watershed_u8
