@@ -271,8 +271,13 @@ int64_t mws_clustering(int64_t n_nodes, int64_t n_attr, const int64_t* uv_attr,
             if (have_mutex(ru, rv)) continue;
             int64_t keep = ufd.merge(ru, rv);
             int64_t gone = keep == ru ? rv : ru;
-            // rewire constraints of the vanished root
-            if (mtx[gone].size() > mtx[keep].size()) std::swap(mtx[gone], mtx[keep]);
+            // rewire the vanished root's constraints onto the survivor.
+            // NO small-into-large swap here: swapping the two sets breaks
+            // the back-pointer symmetry (partners of the survivor would be
+            // "rewired" as if they pointed at the vanished root), leaving
+            // stale entries that eventually put a root inside its own set
+            // — and erasing an element of the set being iterated is UB
+            // (observed as a segfault on near-uniform affinity fields)
             for (int64_t c : mtx[gone]) {
                 mtx[c].erase(gone);
                 if (c != keep) {

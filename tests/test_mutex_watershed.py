@@ -171,3 +171,27 @@ def test_two_pass_mws_workflow_recovers_gt(tmp_workdir, tmp_path, target):
         n += k
     assert _partitions_equal(seg, expected, ignore_zero=False)
     assert seg.max() == len(np.unique(seg))
+
+
+def test_mws_clustering_near_uniform_weights_stress():
+    """Regression: near-uniform affinity fields (e.g. an untrained net's
+    sigmoid outputs) drive dense interleaved merge/constraint sequences;
+    the native constraint rewiring once swapped the two roots' sets,
+    breaking back-pointer symmetry until a root's set contained itself and
+    erase-during-iteration segfaulted.  Must complete and match the pure
+    python reference partition."""
+    from cluster_tools_tpu import native
+    from cluster_tools_tpu.ops.mws import grid_graph_edges
+
+    rng = np.random.RandomState(7)
+    affs = (0.5 + 0.06 * rng.randn(len(OFFSETS), 12, 32, 32)).astype(
+        "float32").clip(0, 1)
+    uva, wa, uvm, wm = grid_graph_edges(affs, OFFSETS)
+    n = int(np.prod(affs.shape[1:]))
+    fast = native.mutex_clustering(n, uva, wa, uvm, wm)
+    assert len(fast) == n
+    ref = native._py_mws(n, np.asarray(uva, "int64").reshape(-1, 2), wa,
+                         np.asarray(uvm, "int64").reshape(-1, 2), wm)
+    pairs = np.unique(np.stack([ref, fast]), axis=1)
+    assert len(np.unique(pairs[0])) == pairs.shape[1]
+    assert len(np.unique(pairs[1])) == pairs.shape[1]
