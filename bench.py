@@ -124,7 +124,11 @@ def run_chain(store_path, shape, workdir, target, host_impl=False,
     # resident device path: input volume uploaded once, per-block fused
     # program (coarse-basins watershed + RAG + stats), RLE label
     # downloads, in-RAM fragment staging for faces + final write
-    cfg.write_task_config("fused_segmentation", ws_params)
+    # pair_cap: measured ~2.5M valid boundary samples per [50,512,512]
+    # block on this instance; 3.15M adds 25% margin (overflow falls back
+    # to a worst-case-capacity redo, so the tight cap is safe)
+    cfg.write_task_config("fused_segmentation",
+                          {**ws_params, "pair_cap": 3 << 20})
     cfg.write_task_config("initial_sub_graphs", impl)
     cfg.write_task_config("block_edge_features", impl)
     if max_jobs is None:

@@ -181,8 +181,8 @@ def config2():
 # config 3: mutex watershed on long-range affinities
 # ---------------------------------------------------------------------------
 
-MWS_SHAPE = (48, 384, 384)
-MWS_BLOCK = [24, 128, 128]
+MWS_SHAPE = (64, 512, 512)
+MWS_BLOCK = [32, 256, 256]
 
 
 def run_mws_chain(store, target="tpu"):
@@ -217,7 +217,7 @@ def config3():
     from cluster_tools_tpu.utils.validation import (ContingencyTable,
                                                     cremi_score_from_table)
 
-    gt = _voronoi_gt(MWS_SHAPE, n_cells=100)
+    gt = _voronoi_gt(MWS_SHAPE, n_cells=240)
     affs = _affs_from_gt(gt, OFFSETS)
     store = "/tmp/ctt_bench_cfg/mws.n5"
     shutil.rmtree(store, ignore_errors=True)

@@ -95,12 +95,78 @@ def _grid_edges_device(affs: jnp.ndarray, mask: jnp.ndarray, key: jnp.ndarray,
     return out
 
 
+def grid_graph_edges_host(affs: np.ndarray,
+                          offsets: Sequence[Sequence[int]],
+                          strides: Optional[Sequence[int]] = None,
+                          mask: Optional[np.ndarray] = None):
+    """Host (numpy) edge extraction — same semantics as the device path
+    for the deterministic cases (no noise, no randomized strides).
+
+    The clustering consumer needs the FULL edge list in host memory, and
+    the indices are pure arange arithmetic over data the host already
+    read from the store — on link-attached accelerators the device
+    detour would upload the affinities and download ~12 bytes/edge for
+    arrays the host can produce for free (the reference keeps this whole
+    stage in CPU C++ for the same reason, affogato)."""
+    ndim = len(offsets[0])
+    shape = affs.shape[1:]
+    strides = tuple(int(s) for s in (strides or (1,) * ndim))
+    if mask is not None:
+        mask = np.asarray(mask).astype(bool)
+    flat = np.arange(int(np.prod(shape)), dtype=np.int64).reshape(shape)
+    uva, wa, uvm, wm = [], [], [], []
+    for c, off in enumerate(offsets):
+        sl_a, sl_b = _offset_slices(off, shape)
+        u = flat[sl_a].reshape(-1)
+        v = flat[sl_b].reshape(-1)
+        # float32 arithmetic first, exactly like the device program —
+        # computing 1-w in float64 would order some edge priorities
+        # differently between the two impls
+        w = affs[c][sl_a].reshape(-1).astype("float32")
+        valid = np.ones(u.shape, bool)
+        if mask is not None:
+            valid &= (mask[sl_a].reshape(-1) & mask[sl_b].reshape(-1))
+        if c >= ndim:
+            w = np.float32(1.0) - w
+            if any(s > 1 for s in strides):
+                on_grid = np.ones(affs[c][sl_a].shape, bool)
+                for ax in range(ndim):
+                    pos = np.arange(on_grid.shape[ax]) \
+                        + (sl_a[ax].start or 0)
+                    sel = (pos % strides[ax]) == 0
+                    shp = [1] * ndim
+                    shp[ax] = on_grid.shape[ax]
+                    on_grid &= sel.reshape(shp)
+                valid &= on_grid.reshape(-1)
+        uv = np.stack([u[valid], v[valid]], axis=1)
+        (uva if c < ndim else uvm).append(uv)
+        (wa if c < ndim else wm).append(w[valid].astype("float64"))
+
+    def cat_uv(xs):
+        return (np.concatenate(xs, axis=0) if xs
+                else np.zeros((0, 2), dtype="int64"))
+
+    return (cat_uv(uva), np.concatenate(wa) if wa else np.zeros(0),
+            cat_uv(uvm), np.concatenate(wm) if wm else np.zeros(0))
+
+
 def grid_graph_edges(affs: np.ndarray, offsets: Sequence[Sequence[int]],
                      strides: Optional[Sequence[int]] = None,
                      randomize_strides: bool = False,
                      mask: Optional[np.ndarray] = None,
-                     noise_level: float = 0.0, seed: int = 0):
-    """Extract (uv_attractive, w_attractive, uv_mutex, w_mutex) host arrays."""
+                     noise_level: float = 0.0, seed: int = 0,
+                     impl: str = "auto"):
+    """Extract (uv_attractive, w_attractive, uv_mutex, w_mutex) host arrays.
+
+    ``impl='auto'`` uses the host path for the deterministic cases (see
+    grid_graph_edges_host) and the device program when noise injection or
+    randomized strides need the jax PRNG stream."""
+    if impl == "auto":
+        impl = ("device" if (noise_level > 0 or randomize_strides)
+                else "host")
+    if impl == "host":
+        return grid_graph_edges_host(affs, offsets, strides=strides,
+                                     mask=mask)
     ndim = len(offsets[0])
     shape = affs.shape[1:]
     assert affs.shape[0] == len(offsets), (affs.shape, len(offsets))
@@ -113,15 +179,28 @@ def grid_graph_edges(affs: np.ndarray, offsets: Sequence[Sequence[int]],
         jax.random.PRNGKey(seed), float(noise_level),
         tuple(tuple(int(o) for o in off) for off in offsets),
         ndim, strides, bool(randomize_strides), have_mask)
+    # FOUR concatenated downloads instead of four per channel: each
+    # np.asarray is its own round trip on tunnel-attached chips, and the
+    # per-channel fetches made small-block extraction latency-bound
+    lengths = [int(u.shape[0]) for u, _, _, _ in per_channel]
+    u_all = np.asarray(jnp.concatenate([u for u, _, _, _ in per_channel]))
+    v_all = np.asarray(jnp.concatenate([v for _, v, _, _ in per_channel]))
+    w_all = np.asarray(jnp.concatenate([w for _, _, w, _ in per_channel]))
+    ok_all = np.asarray(jnp.concatenate(
+        [ok for _, _, _, ok in per_channel]))
     uva: List[np.ndarray] = []
     wa: List[np.ndarray] = []
     uvm: List[np.ndarray] = []
     wm: List[np.ndarray] = []
-    for c, (u, v, w, valid) in enumerate(per_channel):
-        sel = np.asarray(valid)
-        uv = np.stack([np.asarray(u)[sel], np.asarray(v)[sel]], axis=1)
+    pos = 0
+    for c, ln in enumerate(lengths):
+        sl = slice(pos, pos + ln)
+        pos += ln
+        sel = ok_all[sl]
+        uv = np.stack([u_all[sl][sel], v_all[sl][sel]], axis=1)
         (uva if c < ndim else uvm).append(uv)
-        (wa if c < ndim else wm).append(np.asarray(w, dtype="float64")[sel])
+        (wa if c < ndim else wm).append(
+            w_all[sl][sel].astype("float64"))
     def cat_uv(xs):
         return (np.concatenate(xs, axis=0) if xs
                 else np.zeros((0, 2), dtype="int64"))

@@ -1,33 +1,51 @@
 """Fused per-block segmentation chain: watershed + relabel + RAG + edge
-features in ONE device program per block.
+features in ONE device program per block, against a DEVICE-RESIDENT
+volume.
 
 The classic chain (reference call stack, SURVEY §3.1) runs four blockwise
 passes over the volume — watershed, relabel-write, sub-graph extraction,
 edge-feature accumulation — each re-reading the fragments from the store
-and re-uploading them to the device.  On tunnel/PCIe-attached accelerators
-the link traffic dominates: per [50,512,512] block the split chain moves
-~170 MB across the link; the fused program moves ~65 MB (one raw uint8
-upload, one compact int32 label download, two small tables).
+and re-uploading them to the device.  On link-attached accelerators the
+traffic dominates: per [50,512,512] block the split chain moves ~170 MB
+across the link.  The resident path (``ws_method='device'``, default)
+moves ~3 MB per block:
 
-Per block, one jitted program computes:
-  1. normalize -> DT -> seeds -> basin-merge watershed with integrated
-     size filter (ops/watershed._basins_impl);
-  2. DENSE per-block relabel on device (presence + cumsum rank — the
-     RelabelWorkflow becomes unnecessary: the driver adds a running global
-     offset, so the written fragments are globally consecutive);
-  3. interior RAG pairs + per-edge feature statistics
-     (ops/rag.boundary_pair_values + the compacted sort reduction).
+* the reflect-padded input volume uploads ONCE; each block's program
+  ``dynamic_slice``s its outer window from device memory;
+* one jitted program per block: normalize -> EDT -> filters -> seed CC
+  -> 2x-COARSE basin watershed with full-res refinement
+  (ops/watershed._coarse_impl) -> dense per-block relabel (presence +
+  cumsum rank; the driver adds a running global offset, so written
+  fragments are globally consecutive, RelabelWorkflow unnecessary) ->
+  interior RAG pairs + per-edge statistics (exact 256-bin histograms
+  for uint8 inputs, ops/rag._edge_stats_hist_device);
+* downloads per block: a 7-int meta vector, fixed-cap edge tables, and
+  run-length-coded labels (ops/sweep.rle_encode_packed) fetched as plain
+  buffer transfers — never device-side slicing programs, which would
+  queue behind in-flight block programs (the tunnel serializes transfers
+  with compute);
+* fragments stage in host RAM (_FRAGMENT_CACHE), so FusedFaceAssembly
+  and the final write compose from memory instead of re-reading the
+  store; under ``target='mesh'`` rounds of n_devices blocks shard
+  one-per-device through the vmapped program, bit-identical to the
+  streamed result.
+
+``ws_method='hybrid'`` keeps the r3 host-C++-flood variant and
+``'legacy'`` the per-block-upload chain, both for comparison/fallback.
 
 Cross-block (face) edges cannot be known in a single pass — the neighbor
 block's ids do not exist yet — so a cheap host task (FusedFaceAssembly)
-adds them afterwards from 2-voxel-thick plane reads, completing the
-per-block sub-graphs in the exact format the merge/solve stack consumes
-(the reference extracts them with a +1 halo inside
+adds them afterwards from the staged planes, completing the per-block
+sub-graphs in the exact format the merge/solve stack consumes (the
+reference extracts them with a +1 halo inside
 ndist.computeMergeableRegionGraph, graph/initial_sub_graphs.py:114-118).
 
 The assembled problem is bit-compatible with the classic chain: same edge
 sets, same feature statistics (interior + face samples partition the
-reference's sample set), same solver inputs.
+reference's sample set), same solver inputs; the classic Watershed task's
+device path runs the identical watershed composition, so fused and
+classic chains produce the same fragment partition
+(tests/test_fused_pipeline.py).
 """
 
 from __future__ import annotations
@@ -602,7 +620,8 @@ class FusedSegmentationBlocks(BlockTask):
             float(cfg.get("sigma_weights", 2.0)),
             float(cfg.get("alpha", 0.8)),
             int(cfg.get("size_filter", 25) or 0), e_max, rle_cap,
-            int(cfg.get("refine_rounds", 3)))
+            int(cfg.get("refine_rounds", 3)),
+            int(cfg.get("pair_cap", 1 << 22)))
         program = _resident_program(*prog_args)
 
         ws_cache_key = (os.path.abspath(cfg["output_path"]),
@@ -637,8 +656,9 @@ class FusedSegmentationBlocks(BlockTask):
                 # boundaries): redo this block once through the
                 # worst-case-capacity program (compiled lazily, cached)
                 with stage("cap-retry"):
-                    big = _resident_program(*prog_args,
-                                            pair_cap=1 << 24)
+                    big = _resident_program(
+                        *prog_args[:-1],
+                        pair_cap=max(prog_args[-1] * 4, 1 << 24))
                     handles = big(vol_dev,
                                   _origin_extent(blocking.get_block(bid)))
                     return drain((bid, handles), retried=True)

@@ -195,3 +195,22 @@ def test_mws_clustering_near_uniform_weights_stress():
     pairs = np.unique(np.stack([ref, fast]), axis=1)
     assert len(np.unique(pairs[0])) == pairs.shape[1]
     assert len(np.unique(pairs[1])) == pairs.shape[1]
+
+
+def test_grid_graph_edges_host_matches_device():
+    """impl='host' and impl='device' extraction must agree on the full
+    edge sets (ids, weights, stride subsampling, mask handling) — the
+    auto rule swaps them transparently, so divergence would change
+    partitions between runs."""
+    from cluster_tools_tpu.ops.mws import grid_graph_edges
+
+    gt = _make_gt((10, 14, 14), seed=5)
+    affs = _affs_from_gt(gt, OFFSETS, lo=0.1, hi=0.9)
+    mask = np.zeros(gt.shape, np.uint8)  # non-bool on purpose
+    mask[1:9, 2:13, 1:12] = 1
+    kwargs = dict(strides=[2, 2, 2], mask=mask)
+    host = grid_graph_edges(affs, OFFSETS, impl="host", **kwargs)
+    dev = grid_graph_edges(affs, OFFSETS, impl="device", **kwargs)
+    for h, d in zip(host, dev):
+        np.testing.assert_array_equal(np.asarray(h, "float64"),
+                                      np.asarray(d, "float64"))
