@@ -124,11 +124,12 @@ def run_chain(store_path, shape, workdir, target, host_impl=False,
     # resident device path: input volume uploaded once, per-block fused
     # program (coarse-basins watershed + RAG + stats), RLE label
     # downloads, in-RAM fragment staging for faces + final write
-    # pair_cap: measured ~2.5M valid boundary samples per [50,512,512]
-    # block on this instance; 3.15M adds 25% margin (overflow falls back
-    # to a worst-case-capacity redo, so the tight cap is safe)
+    # pair_cap: measured ~1.25M valid boundary PAIRS per [50,512,512]
+    # block on this instance (the uint8 path compacts each pair once,
+    # carrying both side samples); 2.1M adds ~65% margin (overflow falls
+    # back to a worst-case-capacity redo, so the tight cap is safe)
     cfg.write_task_config("fused_segmentation",
-                          {**ws_params, "pair_cap": 3 << 20})
+                          {**ws_params, "pair_cap": 1 << 21})
     cfg.write_task_config("initial_sub_graphs", impl)
     cfg.write_task_config("block_edge_features", impl)
     if max_jobs is None:

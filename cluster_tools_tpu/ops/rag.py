@@ -103,38 +103,13 @@ def boundary_pair_values(labels: jnp.ndarray, bmap: jnp.ndarray,
     Each owned face contributes TWO samples: the boundary-map value at both
     face voxels (nifty gridRag convention — an edge's statistics pool the
     boundary pixels on both sides).  Returns (u, v, value, valid) with the
-    two samples concatenated.
+    two samples concatenated — a thin expansion of
+    :func:`boundary_pair_values_dual`, which owns the face convention.
     """
-    ndim = labels.ndim
-    us, vs, vals, ok = [], [], [], []
-    inner = inner_shape or labels.shape
-    for axis in range(ndim):
-        size = labels.shape[axis] - 1
-        if size <= 0:
-            continue
-        lo_sl, hi_sl = _axis_slices(ndim, axis, size)
-        a, b = labels[lo_sl], labels[hi_sl]
-        fa, fb = bmap[lo_sl], bmap[hi_sl]
-        valid = a != b
-        if ignore_label:
-            valid &= (a != 0) & (b != 0)
-        for ax2 in range(ndim):
-            lim = inner[ax2] if ax2 != axis else min(inner[ax2], size)
-            if a.shape[ax2] > lim:
-                idx = jnp.arange(a.shape[ax2]) < lim
-                shape = [1] * ndim
-                shape[ax2] = a.shape[ax2]
-                valid &= idx.reshape(shape)
-        u = jnp.minimum(a, b).reshape(-1)
-        v = jnp.maximum(a, b).reshape(-1)
-        m = valid.reshape(-1)
-        for fv in (fa, fb):
-            us.append(jnp.where(m, u, 0))
-            vs.append(jnp.where(m, v, 0))
-            vals.append(fv.reshape(-1))
-            ok.append(m)
-    return (jnp.concatenate(us), jnp.concatenate(vs),
-            jnp.concatenate(vals), jnp.concatenate(ok))
+    u, v, va, vb, ok = boundary_pair_values_dual(
+        labels, bmap, ignore_label=ignore_label, inner_shape=inner_shape)
+    return (jnp.concatenate([u, u]), jnp.concatenate([v, v]),
+            jnp.concatenate([va, vb]), jnp.concatenate([ok, ok]))
 
 
 def affinity_pair_values(labels: jnp.ndarray, affs: jnp.ndarray,
@@ -291,37 +266,57 @@ def _edge_stats_device(u, v, values, ok, e_max: int):
     return uv, feats, jnp.minimum(n_runs, e_max), overflow
 
 
-@partial(jax.jit, static_argnames=("e_max",))
-def _edge_stats_hist_device(u, v, bins_u8, ok, e_max: int):
-    """Per-edge statistics via 256-bin histograms — EXACT for uint8
-    boundary maps (the reference's CNN-output convention), and ~2x
-    cheaper than :func:`_edge_stats_device`: the lexsort drops the value
-    key (2-key grouping sort instead of 3-key full sort) and quantiles
-    come from per-edge histogram cumsums instead of sorted-position
-    gathers, reproducing the same position-interpolation formula
-    (``q*(cnt-1)`` with linear interpolation) bit-compatibly for
-    discrete values."""
-    n = u.shape[0]
-    big = jnp.int32(2 ** 31 - 1)
-    u_s = jnp.where(ok, u, big)
-    v_s = jnp.where(ok, v, big)
-    order = jnp.lexsort((v_s, u_s))
-    u_o, v_o = u_s[order], v_s[order]
-    b = bins_u8[order].astype(jnp.int32)
-    valid = u_o != big
-    prev_u = jnp.concatenate([jnp.full((1,), -1, u_o.dtype), u_o[:-1]])
-    prev_v = jnp.concatenate([jnp.full((1,), -1, v_o.dtype), v_o[:-1]])
-    starts = ((u_o != prev_u) | (v_o != prev_v)) & valid
-    run_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
-    n_runs = run_id[-1] + 1
-    run_id = jnp.where(valid & (run_id < e_max), run_id, e_max)
+def boundary_pair_values_dual(labels: jnp.ndarray, bmap: jnp.ndarray,
+                              ignore_label: bool = True,
+                              inner_shape: Optional[Tuple[int, ...]] = None):
+    """Like :func:`boundary_pair_values` but each face pair appears ONCE
+    with BOTH side samples as separate columns — half the pair-array
+    length, so the downstream compaction passes touch half the elements.
+    Returns (u, v, value_a, value_b, valid).  This is the CORE extractor:
+    the two-sample variant is a thin expansion of it, so the
+    face-ownership convention lives in exactly one place."""
+    ndim = labels.ndim
+    us, vs, va, vb, ok = [], [], [], [], []
+    inner = inner_shape or labels.shape
+    for axis in range(ndim):
+        size = labels.shape[axis] - 1
+        if size <= 0:
+            continue
+        lo_sl, hi_sl = _axis_slices(ndim, axis, size)
+        a, b = labels[lo_sl], labels[hi_sl]
+        fa, fb = bmap[lo_sl], bmap[hi_sl]
+        valid = a != b
+        if ignore_label:
+            valid &= (a != 0) & (b != 0)
+        for ax2 in range(ndim):
+            lim = inner[ax2] if ax2 != axis else min(inner[ax2], size)
+            if a.shape[ax2] > lim:
+                idx = jnp.arange(a.shape[ax2]) < lim
+                shape = [1] * ndim
+                shape[ax2] = a.shape[ax2]
+                valid &= idx.reshape(shape)
+        u = jnp.minimum(a, b).reshape(-1)
+        v = jnp.maximum(a, b).reshape(-1)
+        m = valid.reshape(-1)
+        us.append(jnp.where(m, u, 0))
+        vs.append(jnp.where(m, v, 0))
+        va.append(fa.reshape(-1))
+        vb.append(fb.reshape(-1))
+        ok.append(m)
+    return (jnp.concatenate(us), jnp.concatenate(vs),
+            jnp.concatenate(va), jnp.concatenate(vb), jnp.concatenate(ok))
 
+
+def _hist_finish(hist, u_o, v_o, run_id, valid, n_runs, e_max: int):
+    """Shared tail of the histogram edge statistics: exact
+    mean/var/min/max and position-interpolated quantiles from per-edge
+    256-bin histograms (hist still carries the flat dump bin), plus the
+    per-edge (u, v) and overflow accounting.  One implementation for the
+    single- and dual-sample front ends — the stats math must stay
+    bit-compatible between them."""
+    big = jnp.int32(2 ** 31 - 1)
     num = e_max + 1
-    hidx = jnp.where(run_id < e_max, run_id * 256 + b, e_max * 256)
-    hist = jax.ops.segment_sum(
-        jnp.ones((n,), jnp.int32), hidx,
-        num_segments=e_max * 256 + 1)[:e_max * 256].reshape(
-        e_max, 256).astype(jnp.float32)
+    hist = hist[:e_max * 256].reshape(e_max, 256).astype(jnp.float32)
     cnt = hist.sum(axis=1)
     denom = jnp.maximum(cnt, 1.0)
     levels = (jnp.arange(256, dtype=jnp.float32) / 255.0)
@@ -360,6 +355,69 @@ def _edge_stats_hist_device(u, v, bins_u8, ok, e_max: int):
     uv = jnp.stack([uv_u[:e_max], uv_v[:e_max]], axis=1)
     overflow = jnp.sum(jnp.where((run_id == e_max) & valid, 1, 0))
     return uv, feats, jnp.minimum(n_runs, e_max), overflow
+
+
+@partial(jax.jit, static_argnames=("e_max",))
+def _edge_stats_hist_dual(u, v, bins_a_u8, bins_b_u8, ok, e_max: int):
+    """Histogram edge statistics over DUAL-sample pairs (each compacted
+    slot carries the boundary bytes of both face sides): identical
+    results to :func:`_edge_stats_hist_device` fed the two-sample
+    expansion, at half the grouping-sort length."""
+    n = u.shape[0]
+    big = jnp.int32(2 ** 31 - 1)
+    u_s = jnp.where(ok, u, big)
+    v_s = jnp.where(ok, v, big)
+    order = jnp.lexsort((v_s, u_s))
+    u_o, v_o = u_s[order], v_s[order]
+    ba = bins_a_u8[order].astype(jnp.int32)
+    bb = bins_b_u8[order].astype(jnp.int32)
+    valid = u_o != big
+    prev_u = jnp.concatenate([jnp.full((1,), -1, u_o.dtype), u_o[:-1]])
+    prev_v = jnp.concatenate([jnp.full((1,), -1, v_o.dtype), v_o[:-1]])
+    starts = ((u_o != prev_u) | (v_o != prev_v)) & valid
+    run_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    n_runs = run_id[-1] + 1
+    run_id = jnp.where(valid & (run_id < e_max), run_id, e_max)
+
+    ones = jnp.ones((n,), jnp.int32)
+    hidx_a = jnp.where(run_id < e_max, run_id * 256 + ba, e_max * 256)
+    hidx_b = jnp.where(run_id < e_max, run_id * 256 + bb, e_max * 256)
+    hist = (jax.ops.segment_sum(ones, hidx_a,
+                                num_segments=e_max * 256 + 1)
+            + jax.ops.segment_sum(ones, hidx_b,
+                                  num_segments=e_max * 256 + 1))
+    return _hist_finish(hist, u_o, v_o, run_id, valid, n_runs, e_max)
+
+
+@partial(jax.jit, static_argnames=("e_max",))
+def _edge_stats_hist_device(u, v, bins_u8, ok, e_max: int):
+    """Per-edge statistics via 256-bin histograms — EXACT for uint8
+    boundary maps (the reference's CNN-output convention), and ~2x
+    cheaper than :func:`_edge_stats_device`: the lexsort drops the value
+    key (2-key grouping sort instead of 3-key full sort) and quantiles
+    come from per-edge histogram cumsums instead of sorted-position
+    gathers, reproducing the same position-interpolation formula
+    (``q*(cnt-1)`` with linear interpolation) bit-compatibly for
+    discrete values."""
+    n = u.shape[0]
+    big = jnp.int32(2 ** 31 - 1)
+    u_s = jnp.where(ok, u, big)
+    v_s = jnp.where(ok, v, big)
+    order = jnp.lexsort((v_s, u_s))
+    u_o, v_o = u_s[order], v_s[order]
+    b = bins_u8[order].astype(jnp.int32)
+    valid = u_o != big
+    prev_u = jnp.concatenate([jnp.full((1,), -1, u_o.dtype), u_o[:-1]])
+    prev_v = jnp.concatenate([jnp.full((1,), -1, v_o.dtype), v_o[:-1]])
+    starts = ((u_o != prev_u) | (v_o != prev_v)) & valid
+    run_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    n_runs = run_id[-1] + 1
+    run_id = jnp.where(valid & (run_id < e_max), run_id, e_max)
+
+    hidx = jnp.where(run_id < e_max, run_id * 256 + b, e_max * 256)
+    hist = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), hidx,
+                               num_segments=e_max * 256 + 1)
+    return _hist_finish(hist, u_o, v_o, run_id, valid, n_runs, e_max)
 
 
 def device_edge_stats(u, v, values, ok, e_max: int = 65536):

@@ -17,8 +17,9 @@ moves ~3 MB per block:
   (ops/watershed._coarse_impl) -> dense per-block relabel (presence +
   cumsum rank; the driver adds a running global offset, so written
   fragments are globally consecutive, RelabelWorkflow unnecessary) ->
-  interior RAG pairs + per-edge statistics (exact 256-bin histograms
-  for uint8 inputs, ops/rag._edge_stats_hist_device);
+  interior RAG pairs compacted ONCE per pair with both side samples
+  + per-edge statistics (exact 256-bin histograms for uint8 inputs,
+  ops/rag._edge_stats_hist_dual);
 * downloads per block: a 7-int meta vector, fixed-cap edge tables, and
   run-length-coded labels (ops/sweep.rle_encode_packed) fetched as plain
   buffer transfers — never device-side slicing programs, which would
@@ -284,7 +285,8 @@ def _resident_program(outer_shape, halo, in_dtype, threshold: float,
     from ..ops.edt import distance_transform_edt
     from ..ops.filters import gaussian, local_maxima
     from ..ops.rag import (_compact_apply, _compact_tgt, _edge_stats_device,
-                           _edge_stats_hist_device, boundary_pair_values)
+                           _edge_stats_hist_dual, boundary_pair_values,
+                           boundary_pair_values_dual)
     from ..ops.sweep import rle_encode_packed
     from ..ops.watershed import _coarse_impl
 
@@ -338,19 +340,32 @@ def _resident_program(outer_shape, halo, in_dtype, threshold: float,
         k = rank[-1]
         dense_grid = dense.reshape(inner.shape)
 
-        # uint8 inputs keep their RAW byte samples through the stats so
-        # the histogram formulation is exact; float inputs use the full
-        # sorted-position path
-        sample_src = x[inner_sl] if is_u8 else xf[inner_sl]
-        u, v, vals, okp = boundary_pair_values(dense_grid, sample_src)
-        n = int(u.shape[0])
-        cap = min(max(1 << max(int(np.ceil(np.log2(max(n // 6, 1)))), 14),
-                      1 << 14), pair_cap)
-        tgt, cok, cap_overflow = _compact_tgt(okp, cap)
-        stats_fn = _edge_stats_hist_device if is_u8 else _edge_stats_device
-        uv, feats, n_runs, e_overflow = stats_fn(
-            _compact_apply(tgt, u, cap), _compact_apply(tgt, v, cap),
-            _compact_apply(tgt, vals, cap), cok, e_max=e_max)
+        if is_u8:
+            # uint8 inputs keep their RAW byte samples through the stats
+            # (the histogram formulation is exact); each pair compacts
+            # ONCE carrying both side samples — half the element passes
+            u, v, va, vb, okp = boundary_pair_values_dual(dense_grid,
+                                                          x[inner_sl])
+            n = int(u.shape[0])
+            cap = min(max(1 << max(int(np.ceil(
+                np.log2(max(n // 6, 1)))), 13), 1 << 13), pair_cap)
+            tgt, cok, cap_overflow = _compact_tgt(okp, cap)
+            uv, feats, n_runs, e_overflow = _edge_stats_hist_dual(
+                _compact_apply(tgt, u, cap), _compact_apply(tgt, v, cap),
+                _compact_apply(tgt, va, cap), _compact_apply(tgt, vb, cap),
+                cok, e_max=e_max)
+        else:  # float inputs: the full sorted-position path
+            u, v, vals, okp = boundary_pair_values(dense_grid,
+                                                   xf[inner_sl])
+            n = int(u.shape[0])
+            # pair_cap is PAIR-denominated; this path carries two
+            # samples per pair
+            cap = min(max(1 << max(int(np.ceil(
+                np.log2(max(n // 6, 1)))), 14), 1 << 14), 2 * pair_cap)
+            tgt, cok, cap_overflow = _compact_tgt(okp, cap)
+            uv, feats, n_runs, e_overflow = _edge_stats_device(
+                _compact_apply(tgt, u, cap), _compact_apply(tgt, v, cap),
+                _compact_apply(tgt, vals, cap), cok, e_max=e_max)
 
         packed, n_rle, rle_ok = rle_encode_packed(dense, rle_cap)
         meta = jnp.stack([

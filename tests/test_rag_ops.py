@@ -101,3 +101,35 @@ def test_hist_stats_match_sort_stats():
     np.testing.assert_array_equal(np.asarray(uv_s)[:nr], np.asarray(uv_h)[:nr])
     np.testing.assert_allclose(np.asarray(feats_h)[:nr],
                                np.asarray(feats_s)[:nr], rtol=2e-4, atol=2e-6)
+
+
+def test_hist_dual_matches_two_sample_expansion():
+    """Dual-sample histogram stats must equal the two-sample path fed the
+    expanded (duplicated-pair) arrays — the fused chain's uint8 route."""
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.ops.rag import (_edge_stats_hist_device,
+                                           _edge_stats_hist_dual)
+
+    rng = np.random.RandomState(1)
+    n = 4096
+    u = rng.randint(1, 40, n).astype("int32")
+    v = u + rng.randint(1, 10, n).astype("int32")
+    ra = rng.randint(0, 256, n).astype("uint8")
+    rb = rng.randint(0, 256, n).astype("uint8")
+    ok = rng.rand(n) < 0.8
+    uv_d, feats_d, n_d, of_d = _edge_stats_hist_dual(
+        jnp.asarray(u), jnp.asarray(v), jnp.asarray(ra), jnp.asarray(rb),
+        jnp.asarray(ok), e_max=1024)
+    uv_e, feats_e, n_e, of_e = _edge_stats_hist_device(
+        jnp.asarray(np.concatenate([u, u])),
+        jnp.asarray(np.concatenate([v, v])),
+        jnp.asarray(np.concatenate([ra, rb])),
+        jnp.asarray(np.concatenate([ok, ok])), e_max=1024)
+    assert int(n_d) == int(n_e) and int(of_d) == int(of_e) == 0
+    nr = int(n_d)
+    np.testing.assert_array_equal(np.asarray(uv_d)[:nr],
+                                  np.asarray(uv_e)[:nr])
+    np.testing.assert_allclose(np.asarray(feats_d)[:nr],
+                               np.asarray(feats_e)[:nr], rtol=1e-5,
+                               atol=1e-7)
