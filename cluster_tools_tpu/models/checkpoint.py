@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Tuple, Optional
 
 import numpy as np
 
@@ -57,8 +57,11 @@ def save_checkpoint(path: str, model_config: Dict[str, Any], params: Any) -> Non
     np.savez(os.path.join(path, "params.npz"), **flat)
 
 
-def load_checkpoint(path: str) -> Tuple[Any, Dict[str, Any]]:
-    """Return ``(model, params)`` rebuilt from a checkpoint directory."""
+def load_checkpoint(path: str, params: bool = True
+                    ) -> Tuple[Any, Optional[Dict[str, Any]]]:
+    """Return ``(model, params)`` rebuilt from a checkpoint directory;
+    ``params=False`` skips the (potentially large) params.npz read and
+    returns ``(model, None)``."""
     from .unet import create_unet
 
     with open(os.path.join(path, "model.json")) as f:
@@ -67,6 +70,8 @@ def load_checkpoint(path: str) -> Tuple[Any, Dict[str, Any]]:
     if "features" in model_config:
         model_config["features"] = tuple(model_config["features"])
     model = create_unet(**model_config)
+    if not params:
+        return model, None
     with np.load(os.path.join(path, "params.npz")) as data:
         flat = {k: data[k] for k in data.files}
     return model, _unflatten(flat)

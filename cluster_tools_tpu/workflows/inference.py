@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 from concurrent.futures import ThreadPoolExecutor
+from functools import lru_cache
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -78,6 +79,18 @@ def to_uint8(data: np.ndarray, float_range=(0.0, 1.0),
 
 def _round_up(v: int, m: int) -> int:
     return -(-v // m) * m
+
+
+def _checkpoint_mtime(path: str) -> float:
+    """Newest mtime under a checkpoint directory (or of a file)."""
+    if os.path.isdir(path):
+        times = [os.path.getmtime(os.path.join(path, f))
+                 for f in os.listdir(path)]
+        return max(times) if times else 0.0
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
 
 
 def make_predictor(checkpoint_path: str, outer_shape: Sequence[int],
@@ -291,6 +304,39 @@ class InferenceTask(BlockTask):
                 log_fn(f"processed block {done_id}")
 
 
+@lru_cache(maxsize=8)
+def _sharded_fwd(checkpoint_path: str, ckpt_mtime: float, spatial, pad,
+                 preprocess: str):
+    """Cached (params, fwd) per checkpoint content + geometry — a
+    per-call jax.jit wrapper would recompile every invocation, and the
+    checkpoint mtime in the key keeps an in-place retrain from serving a
+    stale model."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.checkpoint import load_checkpoint
+
+    model, params = load_checkpoint(checkpoint_path)
+
+    @jax.jit
+    def fwd(params, x):
+        x = x.astype(jnp.float32)
+        if preprocess == "standardize":
+            mean = x.mean(axis=(1, 2, 3), keepdims=True)
+            std = jnp.maximum(x.std(axis=(1, 2, 3), keepdims=True), 1e-6)
+            x = (x - mean) / std
+        elif preprocess == "normalize":
+            lo = x.min(axis=(1, 2, 3), keepdims=True)
+            hi = x.max(axis=(1, 2, 3), keepdims=True)
+            x = (x - lo) / jnp.maximum(hi - lo, 1e-6)
+        x = jnp.pad(x, pad, mode="reflect")
+        pred = model.apply(params, x[..., None])
+        pred = pred[:, :spatial[0], :spatial[1], :spatial[2]]
+        return jnp.moveaxis(pred, -1, 1)
+
+    return params, fwd
+
+
 def predict_sharded(checkpoint_path: str, volume: np.ndarray,
                     n_devices: Optional[int] = None,
                     preprocess: str = "standardize") -> np.ndarray:
@@ -310,8 +356,8 @@ def predict_sharded(checkpoint_path: str, volume: np.ndarray,
     from ..models.checkpoint import load_checkpoint
     from ..parallel import mesh as mesh_lib
 
-    model, params = load_checkpoint(checkpoint_path)
     mesh = mesh_lib.make_mesh(n_devices or jax.device_count())
+    model, _ = load_checkpoint(checkpoint_path, params=False)
     div = model.min_divisor()
     n, *spatial = volume.shape
     padded = tuple(_round_up(s, d) for s, d in zip(spatial, div))
@@ -319,21 +365,9 @@ def predict_sharded(checkpoint_path: str, volume: np.ndarray,
     dp = mesh.shape["data"]
     n_pad = _round_up(max(n, dp), dp)
 
-    @jax.jit
-    def fwd(params, x):
-        x = x.astype(jnp.float32)
-        if preprocess == "standardize":
-            mean = x.mean(axis=(1, 2, 3), keepdims=True)
-            std = jnp.maximum(x.std(axis=(1, 2, 3), keepdims=True), 1e-6)
-            x = (x - mean) / std
-        elif preprocess == "normalize":
-            lo = x.min(axis=(1, 2, 3), keepdims=True)
-            hi = x.max(axis=(1, 2, 3), keepdims=True)
-            x = (x - lo) / jnp.maximum(hi - lo, 1e-6)
-        x = jnp.pad(x, pad, mode="reflect")
-        pred = model.apply(params, x[..., None])
-        pred = pred[:, :spatial[0], :spatial[1], :spatial[2]]
-        return jnp.moveaxis(pred, -1, 1)
+    params, fwd = _sharded_fwd(
+        checkpoint_path, _checkpoint_mtime(checkpoint_path),
+        tuple(spatial), pad, preprocess)
 
     batch = np.zeros((n_pad, *spatial), volume.dtype)
     batch[:n] = volume
