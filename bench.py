@@ -302,7 +302,11 @@ def main():
         "baseline_vox_per_sec": round(baseline, 1),
         "baseline_note": ("reference-faithful scipy chain, target='local', "
                           f"{n_cpu_voxels/1e6:.0f} Mvox subvolume, "
-                          "per-voxel extrapolated"),
+                          "per-voxel extrapolated; host-side throughput "
+                          "varies ~1.5x run-to-run on this shared single "
+                          "core (r4 observed 332-509 kvox/s) while the "
+                          "device throughput is stable — compare the "
+                          "absolute value across rounds"),
         "device": dev_m, "cpu": cpu_m, "device_on_cpu_subvolume": dev_sub_m,
         "voi_delta_same_data": voi_delta,
         "peak_rss_gb": round(peak_rss_gb, 2),
