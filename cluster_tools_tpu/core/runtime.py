@@ -509,10 +509,15 @@ class BlockTask(Task):
             config_mod.write_config(self.job_config_path(job_id), job_config)
 
         executor = EXECUTORS[self.target]()
-        stages_before = stages_snapshot()
-        t0 = time.time()
+        # first attempt pins the clock/stage baseline; block-granular
+        # retries recurse back in here, so measuring per attempt would
+        # report only the LAST attempt's cost in the status JSON
+        if self._retry_count == 0:
+            self._attempt_t0 = time.time()
+            self._attempt_stages = stages_snapshot()
+        stages_before = self._attempt_stages
         executor.run(self, list(range(n_jobs)))
-        elapsed = time.time() - t0
+        elapsed = time.time() - self._attempt_t0
 
         # -- success detection + block-granular retry ------------------
         failed_jobs = [j for j in range(n_jobs)
@@ -605,12 +610,21 @@ class BlockTask(Task):
                                         job_config)
 
         executor = EXECUTORS[self.target]()
-        stages_before = stages_snapshot()
-        t0 = time.time()
+        # same cross-attempt baseline as the single-process path: the
+        # status must reflect the WHOLE task, not the final retry
+        if self._retry_count == 0:
+            self._attempt_t0 = time.time()
+            self._attempt_stages = stages_snapshot()
+        stages_before = self._attempt_stages
         if my_jobs:
             executor.run(self, my_jobs)
-        mh.fs_barrier(self.tmp_folder, f"{self.name_with_id}_jobs")
-        elapsed = time.time() - t0
+        # the jobs barrier waits for REAL work (on global tasks, peers sit
+        # here for the lead's entire job) — default unbounded, overridable
+        # via global config; the verdict/status barriers below are pure
+        # bookkeeping and keep the short default
+        mh.fs_barrier(self.tmp_folder, f"{self.name_with_id}_jobs",
+                      timeout=self.global_config.get("barrier_timeout"))
+        elapsed = time.time() - self._attempt_t0
 
         check_jobs = ([0] if global_job else
                       [j for j in range(n_jobs) if job_blocks[j]])

@@ -146,7 +146,8 @@ def _current_token(bdir: str, pc: int) -> Optional[str]:
     return hashlib.sha1("|".join(epochs).encode()).hexdigest()[:12]
 
 
-def fs_barrier(tmp_folder: str, name: str, timeout: float = 600.0,
+def fs_barrier(tmp_folder: str, name: str,
+               timeout: Optional[float] = 600.0,
                poll: float = 0.05) -> None:
     """Filesystem barrier over the shared tmp folder (the reference's
     control plane is exactly files + polling; cluster_tasks.py:466-490).
@@ -186,7 +187,10 @@ def fs_barrier(tmp_folder: str, name: str, timeout: float = 600.0,
         os.replace(tmp, mine)
         return my_round
 
-    deadline = time.time() + timeout
+    # timeout=None waits forever: the jobs barrier of single-lead global
+    # tasks has peers idle for the LEAD's whole job (the fused flagship
+    # runs entirely on the lead) — no finite bound is safe at volume scale
+    deadline = None if timeout is None else time.time() + timeout
     while True:
         token = _current_token(bdir, pc)
         if token is not None:
@@ -201,7 +205,7 @@ def fs_barrier(tmp_folder: str, name: str, timeout: float = 600.0,
                     counts.append(0)
             if all(c >= my_round for c in counts):
                 return
-        if time.time() > deadline:
+        if deadline is not None and time.time() > deadline:
             raise TimeoutError(
                 f"barrier {name}: not all {pc} processes arrived within "
                 f"{timeout}s (token {token})")

@@ -669,11 +669,14 @@ class FusedSegmentationBlocks(BlockTask):
             if cap_over > 0 and not retried:
                 # pair compaction overflow (unusually dense fragment
                 # boundaries): redo this block once through the
-                # worst-case-capacity program (compiled lazily, cached)
+                # worst-case-capacity program (compiled lazily, cached).
+                # The true worst case is 3*n_inner valid boundary pairs
+                # (every axis-neighbor differing), rounded up so the
+                # retry program has one shape per block config
+                worst = 1 << int(np.ceil(np.log2(3 * n_inner)))
                 with stage("cap-retry"):
                     big = _resident_program(
-                        *prog_args[:-1],
-                        pair_cap=max(prog_args[-1] * 4, 1 << 24))
+                        *prog_args[:-1], pair_cap=worst)
                     handles = big(vol_dev,
                                   _origin_extent(blocking.get_block(bid)))
                     return drain((bid, handles), retried=True)

@@ -81,16 +81,24 @@ def _round_up(v: int, m: int) -> int:
     return -(-v // m) * m
 
 
-def _checkpoint_mtime(path: str) -> float:
-    """Newest mtime under a checkpoint directory (or of a file)."""
-    if os.path.isdir(path):
-        times = [os.path.getmtime(os.path.join(path, f))
-                 for f in os.listdir(path)]
-        return max(times) if times else 0.0
+def _checkpoint_mtime(path: str):
+    """Content signature of a checkpoint directory (or file): newest mtime
+    plus total byte size.  mtime alone has ~1 s resolution on many
+    filesystems — an in-place retrain rewriting params.npz within the same
+    timestamp tick would serve stale weights from the lru_cache."""
+    def _stat(p):
+        st = os.stat(p)
+        return st.st_mtime, st.st_size
+
     try:
-        return os.path.getmtime(path)
+        if os.path.isdir(path):
+            stats = [_stat(os.path.join(path, f)) for f in os.listdir(path)]
+            if not stats:
+                return (0.0, 0)
+            return (max(s[0] for s in stats), sum(s[1] for s in stats))
+        return _stat(path)
     except OSError:
-        return 0.0
+        return (0.0, 0)
 
 
 def make_predictor(checkpoint_path: str, outer_shape: Sequence[int],

@@ -151,6 +151,11 @@ class WriteAssignments(BlockTask):
 
             ent = fragment_cache_get(cfg["input_path"], cfg["input_key"],
                                      block_id)
+            # a cache hit is only valid when the fused pass's block grid
+            # matches this task's (inconsistent global config between runs
+            # in one driver process would otherwise write mis-placed labels)
+            if ent is not None and ent[2] != bb:
+                ent = None
             if ent is not None:
                 local, f_off, _ = ent
                 seg = local.astype("uint64")
