@@ -1,0 +1,199 @@
+"""Calibration: per-stage device time of the fused resident program.
+
+The round-4 bench showed the flagship's wall is dominated by one opaque
+``sync-meta`` bucket — the host blocking on the whole per-block device
+program (~2.2 s/block).  This tool breaks that program open: it rebuilds
+the exact chain of ``workflows/fused_pipeline._resident_program`` as a
+ladder of CUMULATIVE-PREFIX jitted programs (stage 1, stages 1-2,
+stages 1-3, ...), runs each on the real chip against the same
+reflect-padded synthetic block the bench uses, and reports the
+per-stage device time as consecutive differences.  Cumulative prefixes
+(rather than isolated stages) keep every stage's input exactly what the
+fused program feeds it and charge each stage its marginal cost including
+the fusion XLA actually performs.
+
+Run:  python calibrate_fused.py [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+BLOCK = [50, 512, 512]
+HALO = [4, 32, 32]
+CFG = dict(threshold=0.25, sigma_seeds=2.0, sigma_weights=2.0, alpha=0.8,
+           min_size=25, e_max=16384, rle_cap=1 << 20, refine_rounds=6,
+           pair_cap=1 << 21, coarse_factor=4)
+
+
+def make_block(seed=0):
+    """One outer block of the bench's synthetic boundary map (uint8)."""
+    from bench import synthetic_instance
+
+    outer = tuple(b + 2 * h for b, h in zip(BLOCK, HALO))
+    _, bnd = synthetic_instance(shape=outer, seed=seed)
+    return np.round(bnd * 255).astype("uint8")
+
+
+def build_prefices(outer_shape, halo):
+    """Ordered (name, jitted_program) list; program i runs stages 0..i of
+    the resident chain and returns a tiny reduction (forces execution,
+    keeps d2h out of the timing)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.ops.components import connected_components
+    from cluster_tools_tpu.ops.edt import distance_transform_edt
+    from cluster_tools_tpu.ops.filters import gaussian, local_maxima
+    from cluster_tools_tpu.ops.rag import (
+        _edge_stats_hist_packed, boundary_pair_values_dual, compact_valid)
+    from cluster_tools_tpu.ops.sweep import rle_encode_packed
+    from cluster_tools_tpu.ops.watershed import _coarse_impl
+
+    inner_sl = tuple(slice(h, o - h) for h, o in zip(halo, outer_shape))
+    n_outer = int(np.prod(outer_shape))
+    inner_shape = tuple(o - 2 * h for h, o in zip(halo, outer_shape))
+    n_inner = int(np.prod(inner_shape))
+    c = CFG
+
+    def normalize(x):
+        return x.astype(jnp.float32) * (1.0 / 255.0)
+
+    def to_edt(x):
+        xf = normalize(x)
+        fg = xf < c["threshold"]
+        return xf, fg, distance_transform_edt(fg)
+
+    def to_height(x):
+        xf, fg, dt = to_edt(x)
+        height = c["alpha"] * gaussian(xf, c["sigma_weights"]) + \
+            (1.0 - c["alpha"]) * (1.0 - dt / jnp.maximum(dt.max(), 1e-6))
+        return xf, fg, dt, height
+
+    def to_maxima(x):
+        xf, fg, dt, height = to_height(x)
+        maxima = local_maxima(gaussian(dt, c["sigma_seeds"]), radius=2) & fg
+        return xf, height, maxima
+
+    def to_seeds(x):
+        xf, height, maxima = to_maxima(x)
+        seeds = connected_components(maxima, connectivity=3,
+                                     method="propagation")
+        return xf, height, seeds
+
+    def to_ws(x):
+        xf, height, seeds = to_seeds(x)
+        ws, ok = _coarse_impl(height, seeds, c["min_size"],
+                              c["refine_rounds"], c["coarse_factor"])
+        return xf, ws, ok
+
+    def to_dense(x):
+        xf, ws, ok = to_ws(x)
+        inner = ws[inner_sl]
+        flat = inner.reshape(-1)
+        pres = jnp.zeros((n_outer + 2,), jnp.int32).at[flat].set(
+            1, mode="drop")
+        pres = pres.at[0].set(0)
+        rank = jnp.cumsum(pres)
+        dense = jnp.where(flat > 0, rank[flat], 0).astype(jnp.int32)
+        return xf, dense.reshape(inner.shape), rank[-1]
+
+    def to_stats(x):
+        xf, dense_grid, k = to_dense(x)
+        u, v, va, vb, okp = boundary_pair_values_dual(dense_grid,
+                                                      x[inner_sl])
+        n = int(u.shape[0])
+        cap = min(max(1 << max(int(np.ceil(
+            np.log2(max(n // 6, 1)))), 13), 1 << 13), c["pair_cap"])
+        key = u * 32768 + v
+        vab = va.astype(jnp.int32) * 256 + vb.astype(jnp.int32)
+        (ckey, cvab), cok, cap_overflow = compact_valid(
+            okp, [key, vab], cap)
+        uv, feats, n_runs, e_overflow = _edge_stats_hist_packed(
+            ckey, cvab, cok, e_max=c["e_max"])
+        return dense_grid, uv, feats, n_runs, k
+
+    def to_rle(x):
+        dense_grid, uv, feats, n_runs, k = to_stats(x)
+        packed, n_rle, rle_ok = rle_encode_packed(
+            dense_grid.reshape(-1), c["rle_cap"])
+        return uv, feats, n_runs, k, packed, n_rle
+
+    def small(*outs):
+        """Tiny summary forcing all outputs."""
+        acc = jnp.float32(0)
+        for o in outs:
+            acc = acc + jnp.asarray(o).astype(jnp.float32).sum() % 1024
+        return acc
+
+    prefices = [
+        ("normalize", jax.jit(lambda x: small(normalize(x)))),
+        ("edt", jax.jit(lambda x: small(*to_edt(x)))),
+        ("height(gauss)", jax.jit(lambda x: small(*to_height(x)))),
+        ("seed-maxima", jax.jit(lambda x: small(*to_maxima(x)))),
+        ("seed-cc", jax.jit(lambda x: small(*to_seeds(x)))),
+        ("coarse-ws", jax.jit(lambda x: small(*to_ws(x)))),
+        ("dense-relabel", jax.jit(lambda x: small(*to_dense(x)))),
+        ("pairs+hist", jax.jit(lambda x: small(*to_stats(x)))),
+        ("rle", jax.jit(lambda x: small(*to_rle(x)))),
+    ]
+    return prefices
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--seeds", type=int, default=2,
+                    help="distinct blocks (averages data-dependent "
+                    "while_loop trip counts)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    outer_shape = tuple(b + 2 * h for b, h in zip(BLOCK, HALO))
+    blocks = [jnp.asarray(make_block(seed=s)) for s in range(args.seeds)]
+    jax.block_until_ready(blocks)
+    print(f"device: {jax.devices()[0]}  outer block: {outer_shape} "
+          f"({np.prod(outer_shape)/1e6:.1f} Mvox)")
+
+    prefices = build_prefices(outer_shape, tuple(HALO))
+    cum = []
+    for name, prog in prefices:
+        # warmup (compile) on each distinct block shape/value
+        for b in blocks:
+            jax.block_until_ready(prog(b))
+        ts = []
+        for _ in range(args.reps):
+            for b in blocks:
+                t0 = time.perf_counter()
+                jax.block_until_ready(prog(b))
+                ts.append(time.perf_counter() - t0)
+        cum.append((name, float(np.median(ts))))
+        print(f"  cumulative through {name:<14s} {np.median(ts):7.3f}s")
+
+    print("\nper-stage device time (marginal):")
+    table = {}
+    total = cum[-1][1]
+    prev = 0.0
+    for name, t in cum:
+        dt = t - prev
+        table[name] = round(dt, 4)
+        print(f"  {name:<14s} {dt:7.3f}s  ({100*dt/max(total, 1e-9):5.1f}%)")
+        prev = t
+    print(f"  {'TOTAL':<14s} {total:7.3f}s")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"outer_shape": list(outer_shape),
+                       "cumulative": dict(cum), "per_stage": table,
+                       "total_s": cum[-1][1]}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

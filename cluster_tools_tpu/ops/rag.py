@@ -177,29 +177,33 @@ def affinity_pair_values(labels: jnp.ndarray, affs: jnp.ndarray,
 
 
 @partial(jax.jit, static_argnames=("cap",))
-def _compact_tgt(ok, cap: int):
-    """Scatter targets compacting the valid samples into ``cap`` slots.
+def compact_valid(ok, arrays, cap: int):
+    """Compact the valid samples of several same-layout arrays into ``cap``
+    slots: one shared cumsum computes each valid element's target slot,
+    then every channel pays one scatter pass (invalid entries go OUT OF
+    BOUNDS, ``mode='drop'`` — an in-bounds dump slot would serialize
+    millions of colliding writes on TPU).  Entries past ``cap`` are
+    counted in the overflow return.
 
-    The padded pair arrays are ~6-10x the block size but only the fragment
-    BOUNDARY voxels carry valid samples (~10-15%); sorting the full padded
-    arrays dominated feature extraction (a 2^27-element 3-key lexsort is
-    ~6 s on device vs ~0.8 s at 2^24).  cumsum + scatter compaction is one
-    cheap pass; entries past ``cap`` are counted in the overflow return.
-    The target map is computed ONCE per block and shared by every value
-    channel (the filter-bank path compacts ~10 responses per block)."""
+    Each scatter is an O(n) pass (~0.3 s at the fused block's ~40M pair
+    elements), so hot paths should MINIMIZE CHANNELS by packing several
+    small fields into one int32 (see
+    :func:`_edge_stats_hist_packed` — the uint8 flagship path packs
+    (u,v) and (byte_a,byte_b) into two channels).  Gather-based
+    alternatives were measured and rejected on real blocks: a
+    ``searchsorted`` position discovery costs ~3.9 s (26 binary-search
+    rounds of random gathers from the 156 MB cumsum) and row-scatter of
+    an (n, 4) operand ~2.7 s.
+
+    Returns ``(compacted_list, cok, overflow)`` (slot s holds the s-th
+    valid sample; ``cok`` flags the populated slots)."""
     idx = jnp.cumsum(ok.astype(jnp.int32)) - 1
-    # invalid entries go OUT OF BOUNDS (mode='drop' skips the write) — an
-    # in-bounds dump slot would collect millions of colliding writes, which
-    # TPU scatter serializes (~6 s/pass measured at 2^27)
     tgt = jnp.where(ok & (idx < cap), idx, cap + 1)
     n_valid = jnp.sum(ok.astype(jnp.int32))
     cok = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(n_valid, cap)
-    return tgt, cok, jnp.maximum(n_valid - cap, 0)
-
-
-@partial(jax.jit, static_argnames=("cap",))
-def _compact_apply(tgt, x, cap: int):
-    return jnp.zeros((cap + 1,), x.dtype).at[tgt].set(x, mode="drop")[:cap]
+    return ([jnp.zeros((cap + 1,), x.dtype).at[tgt].set(
+        x, mode="drop")[:cap] for x in arrays],
+        cok, jnp.maximum(n_valid - cap, 0))
 
 
 @partial(jax.jit, static_argnames=("e_max",))
@@ -390,6 +394,42 @@ def _edge_stats_hist_dual(u, v, bins_a_u8, bins_b_u8, ok, e_max: int):
 
 
 @partial(jax.jit, static_argnames=("e_max",))
+def _edge_stats_hist_packed(key, vab, ok, e_max: int):
+    """Histogram edge statistics over PACKED dual-sample pairs: ``key``
+    carries ``u * 32768 + v`` (requires every dense label < 2^15 — the
+    caller guards this; any block that dense would overflow ``e_max``
+    anyway) and ``vab`` carries ``byte_a * 256 + byte_b``.  Identical
+    results to :func:`_edge_stats_hist_dual`, but the compaction upstream
+    pays TWO scatter passes instead of four and the grouping sort is a
+    single-key sort with one payload operand instead of a two-key
+    lexsort — the pair-statistics stage was the hottest piece of the
+    fused block program (calibration r5: 1.56 s of the 2.8 s block)."""
+    n = key.shape[0]
+    big = jnp.int32(2 ** 31 - 1)
+    k_s = jnp.where(ok, key, big)
+    k_o, vab_o = jax.lax.sort([k_s, vab], num_keys=1)
+    valid = k_o != big
+    prev = jnp.concatenate([jnp.full((1,), -1, k_o.dtype), k_o[:-1]])
+    starts = (k_o != prev) & valid
+    run_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    n_runs = run_id[-1] + 1
+    run_id = jnp.where(valid & (run_id < e_max), run_id, e_max)
+
+    ba = vab_o >> 8
+    bb = vab_o & 255
+    ones = jnp.ones((n,), jnp.int32)
+    hidx_a = jnp.where(run_id < e_max, run_id * 256 + ba, e_max * 256)
+    hidx_b = jnp.where(run_id < e_max, run_id * 256 + bb, e_max * 256)
+    hist = (jax.ops.segment_sum(ones, hidx_a,
+                                num_segments=e_max * 256 + 1)
+            + jax.ops.segment_sum(ones, hidx_b,
+                                  num_segments=e_max * 256 + 1))
+    u_o = k_o >> 15
+    v_o = k_o & 32767
+    return _hist_finish(hist, u_o, v_o, run_id, valid, n_runs, e_max)
+
+
+@partial(jax.jit, static_argnames=("e_max",))
 def _edge_stats_hist_device(u, v, bins_u8, ok, e_max: int):
     """Per-edge statistics via 256-bin histograms — EXACT for uint8
     boundary maps (the reference's CNN-output convention), and ~2x
@@ -485,16 +525,13 @@ def device_edge_stats_submit_multi(u, v, ok, values_list,
     ok = _pad_pow2(ok, n_pad, fill=False)
     if _should_compact(n_pad, compact):
         cap = max(n_pad // 4, 1 << 14)
-        tgt, cok, overflow = _compact_tgt(ok, cap)
-        cu = _compact_apply(tgt, u, cap)
-        cv = _compact_apply(tgt, v, cap)
+        (compacted, cok, overflow) = compact_valid(
+            ok, [u, v] + [_pad_pow2(x, n_pad) for x in values_list], cap)
+        cu, cv = compacted[0], compacted[1]
         return [("compact",
-                 _edge_stats_device(cu, cv,
-                                    _compact_apply(tgt, _pad_pow2(x, n_pad),
-                                                   cap),
-                                    cok, e_max=e_max),
+                 _edge_stats_device(cu, cv, cx, cok, e_max=e_max),
                  overflow, cap)
-                for x in values_list]
+                for cx in compacted[2:]]
     return [("full",
              _edge_stats_device(u, v, _pad_pow2(x, n_pad), ok, e_max=e_max))
             for x in values_list]

@@ -374,18 +374,22 @@ def rle_encode_packed(flat: jnp.ndarray, cap: int):
     tgt = jnp.cumsum(brk.astype(jnp.int32)) - 1
     n_runs = jnp.where(n > 0, tgt[-1] + 1, 0)
     ok = (n_runs <= cap) & (flat.max() < (1 << 16))
+    # run length AT each break position = next break index - own index,
+    # from a reversed exclusive cummin of break indices — lengths then
+    # ride the same packed word as the value, so the encoder pays ONE
+    # O(n) scatter pass instead of two (starts + values)
+    m = jnp.where(brk, idx, jnp.uint32(n))
+    # lax.cummin is the lowered scan primitive; associative_scan's
+    # recursive slicing formulation stalled the remote XLA compile at
+    # this length
+    nb = jax.lax.cummin(m, reverse=True)
+    nb_next = jnp.concatenate([nb[1:], jnp.full((1,), n, jnp.uint32)])
+    lengths = jnp.where(brk, nb_next - idx, 0)
+    packed_full = (lengths << 16) | (flat.astype(jnp.uint32)
+                                     & jnp.uint32(0xFFFF))
     tgt_c = jnp.where(brk & (tgt < cap), tgt, cap + 2)
-    starts = jnp.zeros((cap + 1,), jnp.uint32).at[tgt_c].set(
-        idx, mode="drop")[:cap]
-    values = jnp.zeros((cap + 1,), jnp.uint32).at[tgt_c].set(
-        flat.astype(jnp.uint32), mode="drop")[:cap]
-    run_pos = jnp.arange(cap, dtype=jnp.int32)
-    next_start = jnp.where(run_pos + 1 < n_runs,
-                           jnp.concatenate([starts[1:],
-                                            jnp.zeros((1,), jnp.uint32)]),
-                           jnp.uint32(n))
-    lengths = jnp.where(run_pos < n_runs, next_start - starts, 0)
-    packed = (lengths << 16) | (values & jnp.uint32(0xFFFF))
+    packed = jnp.zeros((cap + 1,), jnp.uint32).at[tgt_c].set(
+        packed_full, mode="drop")[:cap]
     return packed, n_runs, ok
 
 

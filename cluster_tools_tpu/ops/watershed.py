@@ -409,36 +409,56 @@ def _basins_impl(height, seeds, mask, connectivity: int, max_rounds: int,
     return labels.reshape(shape), ok
 
 
-def _coarse_impl(height, seeds, min_size: int, refine_rounds: int):
-    """Jit-composable 2x-coarse basin watershed: mean-pool the height,
-    max-pool the seeds, run the descent-forest + saddle-merge solve
-    (`_basins_impl`) on the 8x-smaller grid — every gather/scatter/cumsum
-    primitive shrinks with it (measured 5.9 s -> ~0.6 s per
-    [58,576,576] block) — then upsample and snap boundaries back at full
-    resolution with ``refine_rounds`` steepest-descent adoption sweeps
-    (pure stencils).  Stays in the flood's divergence class (VI ~0.15 vs
-    the bucket-queue flood; scan-only formulations measured ~0.6,
-    ops/sweep.py).  Odd dims are edge-padded for the pooling and cropped
-    back.  ``min_size`` is in FULL-resolution voxels."""
+def _coarse_impl(height, seeds, min_size: int, refine_rounds: int,
+                 factor: int = 2, dense_ids: bool = False):
+    """Jit-composable ``factor``x-coarse basin watershed: mean-pool the
+    height, max-pool the seeds, run the descent-forest + saddle-merge
+    solve (`_basins_impl`) on the factor^3-smaller grid — every
+    gather/scatter/cumsum primitive shrinks with it (measured 5.9 s at
+    full res -> 0.82 s at 2x -> 0.19 s at 4x per [58,576,576] block) —
+    then upsample and snap boundaries back at full resolution with
+    ``refine_rounds`` steepest-descent adoption sweeps (pure stencils,
+    ~0.11 s regardless of round count).  Stays in the flood's divergence
+    class (VI ~0.15 vs the bucket-queue flood; scan-only formulations
+    measured ~0.6, ops/sweep.py).  Short dims are edge-padded to a
+    multiple of ``factor`` for the pooling and cropped back.
+    ``min_size`` is in FULL-resolution voxels."""
     from .components import _shifted
 
     shape = height.shape
-    pads = tuple((0, s % 2) for s in shape)
+    f = int(factor)
+    pads = tuple((0, (f - s % f) % f) for s in shape)
     if any(p[1] for p in pads):
         height_p = jnp.pad(height, pads, mode="edge")
         seeds_p = jnp.pad(seeds, pads)
     else:
         height_p, seeds_p = height, seeds
-    cshape = tuple(s // 2 for s in height_p.shape)
+    cshape = tuple(s // f for s in height_p.shape)
     cn = int(np.prod(cshape))
-    hc = height_p.reshape(cshape[0], 2, cshape[1], 2,
-                          cshape[2], 2).mean((1, 3, 5))
-    sc = seeds_p.reshape(cshape[0], 2, cshape[1], 2,
-                         cshape[2], 2).max((1, 3, 5))
-    wsc, ok = _basins_impl(hc, sc, None, 1, 64, max(min_size // 8, 1),
+    hc = height_p.reshape(cshape[0], f, cshape[1], f,
+                          cshape[2], f).mean((1, 3, 5))
+    sc = seeds_p.reshape(cshape[0], f, cshape[1], f,
+                         cshape[2], f).max((1, 3, 5))
+    wsc, ok = _basins_impl(hc, sc, None, 1, 64,
+                           max(min_size // (f ** 3), 1),
                            min(max(cn // 8, 4096), cn // 2 + 2),
                            min(max(cn // 2, 16384), cn))
-    ws = jnp.repeat(jnp.repeat(jnp.repeat(wsc, 2, 0), 2, 1), 2, 2)
+    if dense_ids:
+        # dense-rank the label VALUES on the coarse grid (labels out of
+        # the basin solve are full-res seed root indices, bounded only by
+        # the voxel count): sort + binary search at coarse scale is ~free
+        # and shrinks every downstream id table from n_outer to cn
+        # entries (the fused program's per-block relabel cumsum was 18%
+        # of its device time at the full-res bound).  Ids stay
+        # partition-equivalent (first-occurrence-in-sorted-order rank).
+        flatc = wsc.reshape(-1)
+        s = jnp.sort(flatc)
+        is_new = jnp.concatenate([(s[:1] > 0),
+                                  (s[1:] != s[:-1]) & (s[1:] > 0)])
+        rank = jnp.cumsum(is_new.astype(jnp.int32))
+        pos = jnp.searchsorted(s, flatc)
+        wsc = jnp.where(flatc > 0, rank[pos], 0).reshape(wsc.shape)
+    ws = jnp.repeat(jnp.repeat(jnp.repeat(wsc, f, 0), f, 1), f, 2)
     ws = ws[tuple(slice(0, s) for s in shape)]
 
     big = jnp.float32(3.4e38)
@@ -459,7 +479,8 @@ def _coarse_impl(height, seeds, min_size: int, refine_rounds: int):
 
 
 def seeded_watershed_coarse(height, seeds, mask=None, connectivity: int = 1,
-                            min_size: int = 0, refine_rounds: int = 3):
+                            min_size: int = 0, refine_rounds: int = 3,
+                            factor: int = 2):
     """Host-facing wrapper around :func:`_coarse_impl` (3d, maskless —
     masked callers use the full-resolution methods)."""
     if mask is not None:
@@ -470,13 +491,14 @@ def seeded_watershed_coarse(height, seeds, mask=None, connectivity: int = 1,
                          "(connectivity=1)")
     height = jnp.asarray(height).astype(jnp.float32)
     labels, ok = _coarse_jit(height, jnp.asarray(seeds), int(min_size),
-                             int(refine_rounds))
+                             int(refine_rounds), int(factor))
     return labels, bool(ok)
 
 
-@partial(jax.jit, static_argnames=("min_size", "refine_rounds"))
-def _coarse_jit(height, seeds, min_size: int, refine_rounds: int):
-    return _coarse_impl(height, seeds, min_size, refine_rounds)
+@partial(jax.jit, static_argnames=("min_size", "refine_rounds", "factor"))
+def _coarse_jit(height, seeds, min_size: int, refine_rounds: int,
+                factor: int = 2):
+    return _coarse_impl(height, seeds, min_size, refine_rounds, factor)
 
 
 @partial(jax.jit, static_argnames=("connectivity", "method"))

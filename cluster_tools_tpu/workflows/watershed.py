@@ -246,7 +246,8 @@ def run_ws_block(data: np.ndarray, cfg: Dict[str, Any],
 
             labels, ok = seeded_watershed_coarse(
                 height, seeds, min_size=min_size or 0,
-                refine_rounds=int(cfg.get("refine_rounds", 3)))
+                refine_rounds=int(cfg.get("refine_rounds", 3)),
+                factor=int(cfg.get("coarse_factor", 2)))
             if ok:
                 return np.array(labels).astype("uint64")
             ws = np.array(seeded_watershed(height, seeds, jmask,
@@ -369,7 +370,8 @@ def iter_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
         float(cfg.get("alpha", 0.8)),
         min_size if fuse_filter else 0,
         return_height=not fuse_filter and bool(min_size),
-        ws_method=algo, refine_rounds=int(cfg.get("refine_rounds", 3)))
+        ws_method=algo, refine_rounds=int(cfg.get("refine_rounds", 3)),
+        coarse_factor=int(cfg.get("coarse_factor", 2)))
 
     def submit(b):
         return b, pipeline(jnp.asarray(b))
@@ -414,7 +416,7 @@ def run_ws_blocks_stream(blocks, cfg: Dict[str, Any]):
 def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
                     sigma_weights: float, alpha: float, min_size: int = 0,
                     return_height: bool = False, ws_method: str = "basins",
-                    refine_rounds: int = 3):
+                    refine_rounds: int = 3, coarse_factor: int = 2):
     """Cached fused jitted pipeline — one compile per parameter set (the
     jit cache lives on the returned function, so re-creating the closure per
     call would recompile every time).  With ``min_size`` the size filter is
@@ -450,7 +452,8 @@ def _ws_pipeline_3d(threshold: float, sigma_seeds: float,
             # composition, size filter integrated
             from ..ops.watershed import _coarse_impl
 
-            ws, ok = _coarse_impl(height, seeds, min_size, refine_rounds)
+            ws, ok = _coarse_impl(height, seeds, min_size, refine_rounds,
+                                  coarse_factor)
         elif ws_method == "basins":
             # the basin formulation fuses the size filter: small fragments
             # are stripped and re-merged in ~2 extra cheap rounds instead
@@ -725,7 +728,8 @@ class WatershedTask(BlockTask):
                 min_size if fuse_filter else 0,
                 return_height=not fuse_filter and bool(min_size),
                 ws_method=algo,
-                refine_rounds=int(cfg.get("refine_rounds", 3)))
+                refine_rounds=int(cfg.get("refine_rounds", 3)),
+                coarse_factor=int(cfg.get("coarse_factor", 2)))
             batched = jax.jit(jax.vmap(pipeline))
 
             block_ids = list(job_config["block_list"])

@@ -150,12 +150,7 @@ class WriteAssignments(BlockTask):
             from .fused_pipeline import fragment_cache_get
 
             ent = fragment_cache_get(cfg["input_path"], cfg["input_key"],
-                                     block_id)
-            # a cache hit is only valid when the fused pass's block grid
-            # matches this task's (inconsistent global config between runs
-            # in one driver process would otherwise write mis-placed labels)
-            if ent is not None and ent[2] != bb:
-                ent = None
+                                     block_id, expect_bb=bb)
             if ent is not None:
                 local, f_off, _ = ent
                 seg = local.astype("uint64")
