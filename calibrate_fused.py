@@ -88,14 +88,17 @@ def build_prefices(outer_shape, halo):
     def to_ws(x):
         xf, height, seeds = to_seeds(x)
         ws, ok = _coarse_impl(height, seeds, c["min_size"],
-                              c["refine_rounds"], c["coarse_factor"])
+                              c["refine_rounds"], c["coarse_factor"],
+                              dense_ids=True)
         return xf, ws, ok
 
     def to_dense(x):
         xf, ws, ok = to_ws(x)
+        cn_bound = int(np.prod([-(-o // c["coarse_factor"])
+                                for o in outer_shape]))
         inner = ws[inner_sl]
         flat = inner.reshape(-1)
-        pres = jnp.zeros((n_outer + 2,), jnp.int32).at[flat].set(
+        pres = jnp.zeros((cn_bound + 2,), jnp.int32).at[flat].set(
             1, mode="drop")
         pres = pres.at[0].set(0)
         rank = jnp.cumsum(pres)
@@ -107,8 +110,8 @@ def build_prefices(outer_shape, halo):
         u, v, va, vb, okp = boundary_pair_values_dual(dense_grid,
                                                       x[inner_sl])
         n = int(u.shape[0])
-        cap = min(max(1 << max(int(np.ceil(
-            np.log2(max(n // 6, 1)))), 13), 1 << 13), c["pair_cap"])
+        cap = max(min(c["pair_cap"],
+                      1 << int(np.ceil(np.log2(max(n, 2))))), 1 << 13)
         key = u * 32768 + v
         vab = va.astype(jnp.int32) * 256 + vb.astype(jnp.int32)
         (ckey, cvab), cok, cap_overflow = compact_valid(
