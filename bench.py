@@ -214,7 +214,8 @@ def task_profile(workdir):
         with open(sf) as f:
             st = json.load(f)
         rows.append((st.get("wall_time", 0.0), st["task"], st.get("n_blocks"),
-                     st.get("stages") or {}))
+                     st.get("stages") or {}, st.get("device_busy_frac"),
+                     st.get("bytes_moved") or {}))
     return sorted(rows, key=lambda r: -r[0])
 
 
@@ -264,10 +265,12 @@ def main():
     dev_t, dev_seg = run_chain(full_store, SHAPE,
                                os.path.join(base, "dev_timed"), "tpu")
     profile = task_profile(os.path.join(base, "dev_timed"))
-    for wall, task, n_blocks, stages in profile[:8]:
+    for wall, task, n_blocks, stages, dbf, mb in profile[:8]:
         stage_txt = " ".join(f"{k}={v:.1f}" for k, v in stages.items())
+        dbf_txt = f" dev_frac={dbf:.2f}" if dbf is not None else ""
         print(f"  device task {task:40s} wall={wall:7.2f}s "
-              f"n_blocks={n_blocks} {stage_txt}", file=sys.stderr, flush=True)
+              f"n_blocks={n_blocks}{dbf_txt} {stage_txt}",
+              file=sys.stderr, flush=True)
 
     cpu_t, cpu_seg = run_cpu_chain_subprocess(cpu_store, CPU_SHAPE,
                                               os.path.join(base, "cpu"))
@@ -315,6 +318,14 @@ def main():
         "device": dev_m, "cpu": cpu_m, "device_on_cpu_subvolume": dev_sub_m,
         "voi_delta_same_data": voi_delta,
         "peak_rss_gb": round(peak_rss_gb, 2),
+        # per-task utilization: accelerator-path share of each task's
+        # wall (device compute + link transfers, one serialized resource
+        # on tunnel backends) + the bytes each stage moved — where the
+        # chip idles is now measured, not guessed (VERDICT r4 item 8)
+        "tasks": [{"task": task, "wall_s": round(wall, 2),
+                   "n_blocks": n_blocks, "device_busy_frac": dbf,
+                   "stages": stages, "bytes_moved": mb}
+                  for wall, task, n_blocks, stages, dbf, mb in profile],
     }))
 
 

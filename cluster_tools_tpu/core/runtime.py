@@ -53,12 +53,37 @@ _STAGE_LINE = "stage times"
 # ---------------------------------------------------------------------------
 
 _STAGE_ACC: Dict[str, float] = {}
+_BYTES_ACC: Dict[str, float] = {}
 _STAGE_LOCK = threading.Lock()
+
+#: stage-name prefixes attributed to the ACCELERATOR PATH (device compute
+#: + link transfers, which the tunnel serializes) when computing the
+#: per-task device_busy_frac in the status JSON — the chip-utilization
+#: observability the bench emits (VERDICT r4 item 8)
+_DEVICE_STAGE_PREFIXES = ("sync-", "d2h-", "h2d-", "dispatch", "cap-retry",
+                          "device-")
 
 
 def stage_add(name: str, seconds: float) -> None:
     with _STAGE_LOCK:
         _STAGE_ACC[name] = _STAGE_ACC.get(name, 0.0) + float(seconds)
+
+
+def stage_bytes(name: str, nbytes: int) -> None:
+    """Attribute moved bytes (host<->device or host<->store) to a stage."""
+    with _STAGE_LOCK:
+        _BYTES_ACC[name] = _BYTES_ACC.get(name, 0.0) + float(nbytes)
+
+
+def bytes_snapshot() -> Dict[str, float]:
+    with _STAGE_LOCK:
+        return dict(_BYTES_ACC)
+
+
+def bytes_delta(before: Dict[str, float]) -> Dict[str, float]:
+    now = bytes_snapshot()
+    return {k: v - before.get(k, 0.0) for k, v in now.items()
+            if v - before.get(k, 0.0) > 0}
 
 
 class stage:
@@ -88,24 +113,31 @@ def stages_delta(before: Dict[str, float]) -> Dict[str, float]:
     return out
 
 
+_BYTES_LINE = "stage bytes"
+
+
 def log_stage_times() -> None:
-    """Emit the worker-side stage accumulator as a parseable log line."""
+    """Emit the worker-side stage accumulators as parseable log lines."""
     st = stages_snapshot()
     if st:
         log(f"{_STAGE_LINE} {json.dumps({k: round(v, 3) for k, v in st.items()})}")
+    by = bytes_snapshot()
+    if by:
+        log(f"{_BYTES_LINE} {json.dumps({k: int(v) for k, v in by.items()})}")
 
 
-def parse_stage_times(log_path: str) -> Dict[str, float]:
+def parse_stage_times(log_path: str, line_tag: str = _STAGE_LINE
+                      ) -> Dict[str, float]:
     out: Dict[str, float] = {}
     if not os.path.exists(log_path):
         return out
     with open(log_path) as f:
         for line in f:
-            pos = line.find(_STAGE_LINE + " {")
+            pos = line.find(line_tag + " {")
             if pos < 0:
                 continue
             try:
-                d = json.loads(line[pos + len(_STAGE_LINE):].strip())
+                d = json.loads(line[pos + len(line_tag):].strip())
             except json.JSONDecodeError:
                 continue
             for k, v in d.items():
@@ -515,6 +547,7 @@ class BlockTask(Task):
         if self._retry_count == 0:
             self._attempt_t0 = time.time()
             self._attempt_stages = stages_snapshot()
+            self._attempt_bytes = bytes_snapshot()
         stages_before = self._attempt_stages
         executor.run(self, list(range(n_jobs)))
         elapsed = time.time() - self._attempt_t0
@@ -524,7 +557,8 @@ class BlockTask(Task):
                        if not parse_job_success(self.log_path(j), j)]
         if not failed_jobs:
             self._write_status(n_jobs, block_list, elapsed,
-                               stages_delta(stages_before))
+                               stages_delta(stages_before),
+                               bytes_delta(self._attempt_bytes))
             return
 
         if (not self.allow_retry
@@ -615,6 +649,7 @@ class BlockTask(Task):
         if self._retry_count == 0:
             self._attempt_t0 = time.time()
             self._attempt_stages = stages_snapshot()
+            self._attempt_bytes = bytes_snapshot()
         stages_before = self._attempt_stages
         if my_jobs:
             executor.run(self, my_jobs)
@@ -664,7 +699,8 @@ class BlockTask(Task):
             # single writer for the shared status file; its stages cover
             # the lead's own jobs (peers' inline stages stay local)
             self._write_status(n_jobs, block_list, elapsed,
-                               stages_delta(stages_before))
+                               stages_delta(stages_before),
+                               bytes_delta(self._attempt_bytes))
         # peers must not observe the task incomplete (build() verifies
         # the target right after run) — wait for the lead's write
         mh.fs_barrier(self.tmp_folder, f"{self.name_with_id}_status")
@@ -683,15 +719,26 @@ class BlockTask(Task):
             f"see {os.path.join(self.tmp_folder, 'logs')}")
 
     def _write_status(self, n_jobs: int, block_list, elapsed: float,
-                      stages: Optional[Dict[str, float]] = None) -> None:
+                      stages: Optional[Dict[str, float]] = None,
+                      moved_bytes: Optional[Dict[str, float]] = None) -> None:
         runtimes = [parse_job_runtime(self.log_path(j)) for j in range(n_jobs)]
         runtimes = [r for r in runtimes if r is not None]
         # subprocess workers report their stages through the job log (the
         # driver-process accumulator only sees in-process executors)
         stages = dict(stages or {})
+        moved_bytes = dict(moved_bytes or {})
         for j in range(n_jobs):
             for k, v in parse_stage_times(self.log_path(j)).items():
                 stages[k] = stages.get(k, 0.0) + v
+            for k, v in parse_stage_times(self.log_path(j),
+                                          _BYTES_LINE).items():
+                moved_bytes[k] = moved_bytes.get(k, 0.0) + v
+        # accelerator-path share of the task wall: device compute + link
+        # transfers (one serialized resource on tunnel backends).  The
+        # complement is host compute + store IO + scheduling — where the
+        # chip idles (VERDICT r4: rounds were being steered blind here)
+        device_time = sum(v for k, v in stages.items()
+                          if k.startswith(_DEVICE_STAGE_PREFIXES))
         status = {
             "task": self.name_with_id,
             "n_jobs": n_jobs,
@@ -701,6 +748,10 @@ class BlockTask(Task):
             "retries": self._retry_count,
             "stages": {k: round(v, 3) for k, v in sorted(
                 stages.items(), key=lambda kv: -kv[1])},
+            "device_busy_frac": (round(device_time / elapsed, 4)
+                                 if elapsed > 0 else None),
+            "bytes_moved": {k: int(v) for k, v in sorted(
+                moved_bytes.items(), key=lambda kv: -kv[1])},
         }
         config_mod.write_config(self.output().path, status)
 

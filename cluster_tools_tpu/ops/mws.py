@@ -209,6 +209,144 @@ def grid_graph_edges(affs: np.ndarray, offsets: Sequence[Sequence[int]],
             cat_uv(uvm), np.concatenate(wm) if wm else np.zeros(0))
 
 
+@partial(jax.jit, static_argnames=("offsets", "strides", "seeded"))
+def _sorted_edges_device(affs, seeds, offsets: Tuple[Tuple[int, ...], ...],
+                         strides: Tuple[int, ...], seeded: bool):
+    """Extract ALL grid edges and sort them by DESCENDING mutex-watershed
+    priority on device, returning (u, v_packed) int32 streams the host
+    union-find scan consumes directly (native.mutex_clustering_sorted).
+
+    The host Kruskal's dominant cost is its stable_sort of tens of
+    millions of 24-byte edge structs; the device does that sort as one
+    fused key+payload sort and ships 8 bytes/edge back.  v_packed packs
+    the partner index with the edge class: bit 30 = mutex edge, bit 29 =
+    dropped (zero-affinity attractive or off-stride mutex; kept in the
+    stream so the layout is static, skipped by the scan via u = -1).
+
+    ``seeds`` (int32 volume, 0 = unseeded) boost intra-seed attractive
+    edges above every data weight (the two-pass seeded variant); pass a
+    dummy scalar array when ``seeded`` is False.
+    """
+    shape = affs.shape[1:]
+    ndim = len(shape)
+    flat = jnp.arange(int(np.prod(shape)), dtype=jnp.int32).reshape(shape)
+    sflat = seeds.reshape(-1) if seeded else None
+    us, vs, ws, ms, oks = [], [], [], [], []
+    for c, off in enumerate(offsets):
+        sl_a, sl_b = _offset_slices(off, shape)
+        u = flat[sl_a].reshape(-1)
+        v = flat[sl_b].reshape(-1)
+        w = affs[c][sl_a].reshape(-1).astype(jnp.float32)
+        is_mutex = c >= ndim
+        valid = jnp.ones(u.shape, bool)
+        if is_mutex:
+            w = 1.0 - w
+            if any(s > 1 for s in strides):
+                on_grid = jnp.ones(affs[c][sl_a].shape, bool)
+                for ax in range(ndim):
+                    pos = jnp.arange(on_grid.shape[ax]) \
+                        + (sl_a[ax].start or 0)
+                    sel = (pos % strides[ax]) == 0
+                    shp = [1] * ndim
+                    shp[ax] = on_grid.shape[ax]
+                    on_grid &= sel.reshape(shp)
+                valid &= on_grid.reshape(-1)
+        else:
+            if seeded:
+                su, sv = sflat[u], sflat[v]
+                w = jnp.where((su != 0) & (su == sv), jnp.float32(2.0), w)
+            # zero-affinity attractive edges carry no merge evidence
+            # (deliberate deviation from affogato, see
+            # mutex_watershed_segmentation)
+            valid &= w > 0
+        us.append(u)
+        vs.append(v)
+        ws.append(w)
+        ms.append(jnp.full(u.shape, is_mutex, bool))
+        oks.append(valid)
+    u_all = jnp.concatenate(us)
+    v_all = jnp.concatenate(vs)
+    w_all = jnp.concatenate(ws)
+    m_all = jnp.concatenate(ms)
+    ok_all = jnp.concatenate(oks)
+    # invalid edges sink to the end of the descending order
+    key = jnp.where(ok_all, -w_all, jnp.float32(np.inf))
+    u_s = jnp.where(ok_all, u_all, -1)
+    v_packed = (v_all
+                | (m_all.astype(jnp.int32) << 30)
+                | ((~ok_all).astype(jnp.int32) << 29))
+    _, u_sorted, vp_sorted = jax.lax.sort(
+        [key, u_s, v_packed], num_keys=1, is_stable=True)
+    return u_sorted, vp_sorted
+
+
+@partial(jax.jit, static_argnames=("outer_shape", "offsets", "strides",
+                                   "seeded"))
+def _sorted_edges_resident_impl(vol, origin, seeds,
+                                outer_shape: Tuple[int, ...],
+                                offsets: Tuple[Tuple[int, ...], ...],
+                                strides: Tuple[int, ...], seeded: bool):
+    affs = jax.lax.dynamic_slice(
+        vol, (0,) + tuple(origin[d] for d in range(len(outer_shape))),
+        (vol.shape[0],) + outer_shape)
+    u_sorted, vp_sorted = _sorted_edges_device(affs, seeds, offsets,
+                                               strides, seeded)
+    return u_sorted, vp_sorted, affs.sum()
+
+
+def _sorted_edges_resident(affs_dev, origin, outer_shape,
+                           offsets, strides,
+                           seeds: Optional[np.ndarray] = None):
+    """Submit one block's extract+sort against the DEVICE-RESIDENT
+    affinity volume without synchronizing: dynamic-slice the outer
+    window, sort every grid edge by descending priority.  Returns
+    (u_sorted, v_packed, block_affinity_sum) device handles — callers
+    pipeline the host scan of block i with the device sort of i+1.
+    The affinity sum reproduces the host path's skip-empty-block rule
+    without a separate download."""
+    import jax.numpy as jnp
+
+    seeded = seeds is not None
+    seeds_in = (jnp.asarray(np.asarray(seeds).astype("int32"))
+                if seeded else jnp.zeros((1,) * len(outer_shape), jnp.int32))
+    return _sorted_edges_resident_impl(
+        affs_dev, jnp.asarray(origin, dtype=jnp.int32), seeds_in,
+        tuple(int(s) for s in outer_shape),
+        tuple(tuple(int(o) for o in off) for off in offsets),
+        tuple(int(s) for s in strides), seeded)
+
+
+def mutex_watershed_finalize_sorted(handles, shape, asum=None,
+                                    mask: Optional[np.ndarray] = None):
+    """Download one block's sorted edge stream and run the host scan.
+    Returns (labels, affinity_sum): uint64 labels consecutive from 1
+    (0 on masked voxels); when ``asum`` (a device handle) reports an
+    all-zero block the scan is skipped and labels is None."""
+    u_sorted, vp_sorted = handles
+    a = float(np.asarray(asum)) if asum is not None else None
+    if a == 0.0:
+        return None, 0.0
+    u = np.asarray(u_sorted)
+    vp = np.asarray(vp_sorted)
+    dropped = (vp >> 29) & 1
+    u = np.where(dropped != 0, np.int32(-1), u)
+    v = vp & np.int32((1 << 29) - 1)
+    flags = ((vp >> 30) & 1).astype(np.uint8)
+    n_nodes = int(np.prod(shape))
+    cluster = native.mutex_clustering_sorted(n_nodes, u, v, flags)
+    labels = cluster.reshape(shape)
+    if mask is not None:
+        labels = np.where(mask, labels + 1, 0)
+    else:
+        labels = labels + 1
+    uniq, inv = np.unique(labels, return_inverse=True)
+    if uniq.size and uniq[0] == 0:
+        labels = inv.reshape(shape).astype("uint64")
+    else:
+        labels = (inv.reshape(shape) + 1).astype("uint64")
+    return labels, (a if a is not None else 1.0)
+
+
 def mutex_watershed_segmentation(
         affs: np.ndarray, offsets: Sequence[Sequence[int]],
         strides: Optional[Sequence[int]] = None,

@@ -638,7 +638,8 @@ class FusedSegmentationBlocks(BlockTask):
         the face-assembly and final-write tasks never re-read the store."""
         import jax.numpy as jnp
 
-        from ..core.runtime import stage, stage_add, stream_window
+        from ..core.runtime import (stage, stage_add, stage_bytes,
+                                    stream_window)
         from ..ops.sweep import rle_decode_packed
         from .watershed import _normalize_input
 
@@ -651,6 +652,7 @@ class FusedSegmentationBlocks(BlockTask):
 
         with stage("store-read"):
             vol = ds_in[...]
+        stage_bytes("store-read", vol.nbytes)
         mx = float(vol.max()) if vol.size else 0.0
         is_u8 = (vol.dtype == np.uint8 and mx > 1
                  and not cfg.get("invert_inputs", False))
@@ -678,6 +680,7 @@ class FusedSegmentationBlocks(BlockTask):
             for h, g, b, s in zip(halo, gdims, bs, shape)])]
         with stage("h2d-upload"):
             vol_dev = jnp.asarray(volp)
+        stage_bytes("h2d-upload", volp.nbytes)
 
         prog_args = (
             outer_shape, tuple(halo), str(volp.dtype),
@@ -698,6 +701,7 @@ class FusedSegmentationBlocks(BlockTask):
             t0 = time.perf_counter()
             ds_out[bb] = arr
             stage_add("store-write", time.perf_counter() - t0)
+            stage_bytes("store-write", arr.nbytes)
 
         def _origin_extent(block):
             return jnp.asarray(
@@ -715,6 +719,7 @@ class FusedSegmentationBlocks(BlockTask):
             tbl_d, plo_d, phi_d, dense16_d, dense_d = handles
             with stage("sync-meta"):
                 tbl = np.asarray(tbl_d)
+            stage_bytes("sync-meta", tbl.nbytes)
             (k_i, n_r, e_over, cap_over, ws_ok, n_rle,
              rle_ok) = (int(x) for x in tbl[0, :7])
             if cap_over > 0 and not retried:
@@ -763,15 +768,18 @@ class FusedSegmentationBlocks(BlockTask):
                         if n_rle > packed.shape[0]:
                             packed = np.concatenate(
                                 [packed, np.asarray(phi_d)])
+                    stage_bytes("d2h-rle", packed.nbytes)
                     with stage("host-decode"):
                         dense_np = rle_decode_packed(
                             packed, n_rle, n_inner).reshape(inner_shape)
                 elif k_i < (1 << 16):
                     with stage("d2h-dense"):
                         dense_np = np.asarray(dense16_d)
+                    stage_bytes("d2h-dense", dense_np.nbytes)
                 else:
                     with stage("d2h-dense"):
                         dense_np = np.asarray(dense_d)
+                    stage_bytes("d2h-dense", dense_np.nbytes)
             off = state["offset"]
             local = dense_np[real]
             local = local.astype("uint16" if k_i < 65536 else "uint32")

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -145,6 +146,207 @@ class BlockComponents(BlockTask):
             json.dump(max_ids, f)
 
 
+class ResidentBlockComponents(BlockTask):
+    """Config-2 fast path: threshold + per-block CC against a
+    DEVICE-RESIDENT volume (the flagship's resident treatment applied to
+    the CC chain, VERDICT r4 item 4).  The volume uploads once; each
+    block's jitted program dynamic-slices its window, thresholds, labels
+    components, dense-relabels (presence + cumsum rank), and RLE-packs
+    the labels so only runs cross the link; the host decodes, stages the
+    block in the fragment cache (BlockFaces + the final write then
+    compose from memory), and streams the store write on a writer
+    thread.  Because a single job owns the device, the per-block max-ids
+    fold into the exclusive-offset JSON inline — MergeOffsets is
+    subsumed.  Labels are block-local (1..k, offsets applied by
+    BlockFaces/Write exactly as for BlockComponents), so the chain's
+    semantics are unchanged (reference: block_components.py:143-180 +
+    merge_offsets.py:100-137)."""
+
+    task_name = "block_components"
+
+    def __init__(self, input_path: str, input_key: str, output_path: str,
+                 output_key: str, threshold: float, offsets_path: str,
+                 threshold_mode: str = "greater", **kw):
+        self.input_path = input_path
+        self.input_key = input_key
+        self.output_path = output_path
+        self.output_key = output_key
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.offsets_path = offsets_path
+        super().__init__(**kw)
+
+    @staticmethod
+    def default_task_config():
+        conf = BlockTask.default_task_config()
+        conf.update({"connectivity": 1, "rle_cap": 1 << 20,
+                     "stream_window": 3})
+        return conf
+
+    def run_impl(self):
+        with file_reader(self.input_path, "r") as f:
+            shape = list(f[self.input_key].shape)
+        block_shape = self.global_block_shape()[-len(shape):]
+        with file_reader(self.output_path) as f:
+            f.require_dataset(self.output_key, shape=shape,
+                              chunks=block_shape, dtype="uint64")
+        block_list = self.blocks_in_volume(shape, block_shape)
+        self.run_jobs(block_list, {
+            "input_path": self.input_path, "input_key": self.input_key,
+            "output_path": self.output_path, "output_key": self.output_key,
+            "threshold": self.threshold,
+            "threshold_mode": self.threshold_mode,
+            "offsets_path": self.offsets_path,
+            "shape": shape, "block_shape": block_shape,
+        }, n_jobs=1)
+
+    @classmethod
+    def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
+        from concurrent.futures import ThreadPoolExecutor
+        from functools import lru_cache
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.runtime import (stage, stage_add, stage_bytes,
+                                    stream_window)
+        from ..ops.sweep import rle_decode_packed
+        from .fused_pipeline import _FRAGMENT_CACHE
+
+        cfg = job_config["config"]
+        blocking = Blocking(cfg["shape"], cfg["block_shape"])
+        connectivity = int(cfg.get("connectivity", 1))
+        rle_cap = int(cfg.get("rle_cap", 1 << 20))
+        bs = tuple(cfg["block_shape"])
+        n_block = int(np.prod(bs))
+        threshold = float(cfg["threshold"])
+        mode = cfg["threshold_mode"]
+
+        f_in = file_reader(cfg["input_path"], "r")
+        f_out = file_reader(cfg["output_path"])
+        ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
+
+        with stage("store-read"):
+            vol = np.asarray(ds_in[...])
+        stage_bytes("store-read", vol.nbytes)
+        # grid-aligned zero padding: dynamic_slice CLAMPS out-of-bounds
+        # origins (silently shifting border blocks); the extent mask in
+        # the program zeroes the pad before labeling
+        gshape = [-(-s // b) * b for s, b in zip(cfg["shape"], bs)]
+        if gshape != list(vol.shape):
+            volp = np.zeros(gshape, vol.dtype)
+            volp[tuple(slice(0, s) for s in vol.shape)] = vol
+        else:
+            volp = vol
+        with stage("h2d-upload"):
+            vol_dev = jnp.asarray(volp)
+        stage_bytes("h2d-upload", volp.nbytes)
+
+        @lru_cache(maxsize=2)
+        def program():
+            from ..ops.components import (connected_components,
+                                          threshold_volume)
+            from ..ops.sweep import rle_encode_packed
+
+            def run(v, origin_extent):
+                origin = origin_extent[:3]
+                extent = origin_extent[3:]
+                x = jax.lax.dynamic_slice(
+                    v, tuple(origin[d] for d in range(len(bs))), bs)
+                m = threshold_volume(x, threshold, mode)
+                # clipped border blocks: zero the padded remainder so
+                # phantom components never enter the labeling
+                for d in range(len(bs)):
+                    coord = jnp.arange(bs[d])
+                    shp = [1] * len(bs)
+                    shp[d] = bs[d]
+                    m &= (coord < extent[d]).reshape(shp)
+                lab = connected_components(m, connectivity=connectivity)
+                flat = lab.reshape(-1)
+                pres = jnp.zeros((n_block + 2,), jnp.int32).at[flat].set(
+                    1, mode="drop")
+                pres = pres.at[0].set(0)
+                rank = jnp.cumsum(pres)
+                dense = jnp.where(flat > 0, rank[flat],
+                                  0).astype(jnp.int32)
+                k = rank[-1]
+                packed, n_rle, rle_ok = rle_encode_packed(dense, rle_cap)
+                meta = jnp.stack([k, n_rle,
+                                  rle_ok.astype(jnp.int32)])
+                return meta, packed, dense.reshape(bs)
+
+            return jax.jit(run)
+
+        max_ids: Dict[int, int] = {}
+        write_futures = []
+
+        def _write(bb, arr):
+            t0 = time.perf_counter()
+            ds_out[bb] = arr
+            stage_add("store-write", time.perf_counter() - t0)
+            stage_bytes("store-write", arr.nbytes)
+
+        cache_key = (os.path.abspath(cfg["output_path"]),
+                     cfg["output_key"])
+
+        def submit(bid):
+            block = blocking.get_block(bid)
+            oe = jnp.asarray(
+                list(block.begin) + [e - b for b, e in zip(block.begin,
+                                                           block.end)],
+                dtype=jnp.int32)
+            with stage("dispatch"):
+                return bid, program()(vol_dev, oe)
+
+        def drain(entry):
+            bid, handles = entry
+            meta_d, packed_d, dense_d = handles
+            block = blocking.get_block(bid)
+            real = tuple(slice(0, e - b) for b, e in zip(block.begin,
+                                                         block.end))
+            with stage("sync-meta"):
+                meta = np.asarray(meta_d)
+            k_i, n_rle, rle_ok = (int(x) for x in meta)
+            if rle_ok:
+                with stage("d2h-rle"):
+                    packed = np.asarray(packed_d)
+                stage_bytes("d2h-rle", packed.nbytes)
+                dense_np = rle_decode_packed(
+                    packed, n_rle, n_block).reshape(bs)
+            else:
+                with stage("d2h-dense"):
+                    dense_np = np.asarray(dense_d)
+                stage_bytes("d2h-dense", dense_np.nbytes)
+            local = dense_np[real]
+            local = local.astype("uint16" if k_i < 65536 else "uint32")
+            _FRAGMENT_CACHE[cache_key + (bid,)] = (local, 0, block.bb)
+            write_futures.append(
+                writer.submit(_write, block.bb, local.astype("uint64")))
+            max_ids[bid] = k_i
+            log_fn(f"processed block {bid}")
+
+        with ThreadPoolExecutor(1) as writer:
+            for _ in stream_window(list(job_config["block_list"]),
+                                   submit, drain,
+                                   window=int(cfg.get("stream_window", 3))):
+                pass
+            for fut in write_futures:
+                fut.result()
+
+        # inline MergeOffsets: this single job saw every block
+        n_blocks = blocking.n_blocks
+        ids = np.zeros(n_blocks, dtype="uint64")
+        for bid, mx in max_ids.items():
+            ids[bid] = mx
+        offsets = np.zeros(n_blocks, dtype="uint64")
+        np.cumsum(ids[:-1], out=offsets[1:])
+        with open(cfg["offsets_path"], "w") as f:
+            json.dump({"offsets": offsets.tolist(),
+                       "empty_blocks":
+                           np.nonzero(ids == 0)[0].tolist(),
+                       "n_labels": int(ids.sum())}, f)
+
+
 class MergeOffsets(BlockTask):
     """Global job: per-block max ids -> exclusive prefix offsets, empty-block
     list, total label count (reference: merge_offsets.py:100-137)."""
@@ -227,14 +429,47 @@ class BlockFaces(BlockTask):
         ndim = blocking.ndim
         f = file_reader(cfg["path"], "r")
         ds = f[cfg["key"]]
+
+        from .fused_pipeline import fragment_cache_get
+
+        def face_plane(bb, owner_bid):
+            """One face plane, from the resident pass's in-RAM staging
+            when this process ran it, else from the store."""
+            ent = fragment_cache_get(cfg["path"], cfg["key"], owner_bid,
+                                     expect_bb=blocking.get_block(
+                                         owner_bid).bb)
+            if ent is not None:
+                local, off0, obb = ent
+                rel = tuple(slice(s.start - o.start, s.stop - o.start)
+                            for s, o in zip(bb, obb))
+                out = local[rel].astype("uint64")
+                if off0:
+                    out[out > 0] += np.uint64(off0)
+                return out.ravel()
+            return None
+
         pairs: List[np.ndarray] = []
         for block_id in job_config["block_list"]:
             for face in iterate_faces(blocking, block_id, halo=[1] * ndim):
                 if (face.block_a, face.block_b) in covered:
                     continue
-                region = ds[face.outer_bb]
-                la = region[face.face_a].ravel().astype("uint64")
-                lb = region[face.face_b].ravel().astype("uint64")
+                # absolute plane bbs of the two face sides
+                bb_a = tuple(
+                    slice(o.start + (f_.start or 0),
+                          o.start + (f_.stop if f_.stop is not None
+                                     else (o.stop - o.start)))
+                    for o, f_ in zip(face.outer_bb, face.face_a))
+                bb_b = tuple(
+                    slice(o.start + (f_.start or 0),
+                          o.start + (f_.stop if f_.stop is not None
+                                     else (o.stop - o.start)))
+                    for o, f_ in zip(face.outer_bb, face.face_b))
+                la = face_plane(bb_a, face.block_a)
+                lb = face_plane(bb_b, face.block_b)
+                if la is None or lb is None:
+                    region = ds[face.outer_bb]
+                    la = region[face.face_a].ravel().astype("uint64")
+                    lb = region[face.face_b].ravel().astype("uint64")
                 fg = (la != 0) & (lb != 0)
                 if not fg.any():
                     continue
@@ -345,6 +580,39 @@ class ThresholdedComponentsWorkflow(Task):
         block_shape = ConfigDir(self.config_dir).global_config()["block_shape"]
         n_blocks = Blocking(shape, block_shape[-len(shape):]).n_blocks
 
+        if self.target == "tpu" and not self.mask_path:
+            import jax
+
+            # CTT_FORCE_RESIDENT=1 exercises the resident path on the CPU
+            # backend (the hermetic test suite; on CPU the device detour
+            # has no win, so it is opt-in there)
+            if (jax.default_backend() != "cpu"
+                    or os.environ.get("CTT_FORCE_RESIDENT") == "1"):
+                # resident fast path: one device pass (threshold + CC +
+                # RLE downloads) with inline offsets, faces + final write
+                # composing from the in-RAM staging (VERDICT r4 item 4)
+                t2 = ResidentBlockComponents(
+                    input_path=self.input_path, input_key=self.input_key,
+                    output_path=self.output_path,
+                    output_key=self.output_key,
+                    threshold=self.threshold,
+                    threshold_mode=self.threshold_mode,
+                    offsets_path=offsets_path,
+                    dependency=self.dependency, **self._common())
+                t3 = BlockFaces(path=self.output_path, key=self.output_key,
+                                offsets_path=offsets_path, dependency=t2,
+                                **self._common())
+                t4 = MergeAssignments(offsets_path=offsets_path,
+                                      assignment_path=assignment_path,
+                                      dependency=t3, **self._common())
+                t5 = WriteAssignments(
+                    input_path=self.output_path, input_key=self.output_key,
+                    output_path=self.output_path,
+                    output_key=self.output_key,
+                    assignment_path=assignment_path,
+                    offsets_path=offsets_path,
+                    identifier="cc", dependency=t4, **self._common())
+                return t5
         if self.target == "mesh" and not self.mask_path:
             # SPMD phase: per-block CC + on-device offset scan + ICI face
             # exchange in one program per round (workflows/mesh_blockwise);

@@ -129,7 +129,9 @@ class WriteAssignments(BlockTask):
 
     @classmethod
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
-        from ..core.runtime import stage
+        import time
+
+        from ..core.runtime import stage, stage_add, stage_bytes
 
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
@@ -143,26 +145,59 @@ class WriteAssignments(BlockTask):
         f_in = file_reader(cfg["input_path"], "r" if not in_place else "a")
         f_out = f_in if in_place else file_reader(cfg["output_path"])
         ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
-        for block_id in job_config["block_list"]:
-            bb = blocking.get_block(block_id).bb
-            # the fused pass stages fragments in RAM (same process) — no
-            # store re-read on the flagship path (r3: 25.7 s of the bench)
-            from .fused_pipeline import fragment_cache_get
 
-            ent = fragment_cache_get(cfg["input_path"], cfg["input_key"],
-                                     block_id, expect_bb=bb)
-            if ent is not None:
-                local, f_off, _ = ent
-                seg = local.astype("uint64")
-                seg[seg > 0] += np.uint64(f_off)
-            else:
-                with stage("store-read"):
-                    seg = ds_in[bb].astype("uint64")
-            if offsets is not None:
-                off = np.uint64(offsets[block_id])
-                seg[seg != 0] += off
-            with stage("host-map"):
-                out = apply_assignment_table(seg, table)
-            with stage("store-write"):
-                ds_out[bb] = out
-            log_fn(f"processed block {block_id}")
+        from concurrent.futures import ThreadPoolExecutor
+
+        from .fused_pipeline import fragment_cache_get
+
+        def _write(bb, out):
+            t0 = time.perf_counter()
+            ds_out[bb] = out
+            stage_add("store-write", time.perf_counter() - t0)
+            stage_bytes("store-write", out.nbytes)
+
+        # one writer thread: tensorstore's gzip+IO (GIL released) overlaps
+        # the next block's table gather — the final write was a fully
+        # serial ~10 s tail after the (0.3 s) solve in the r4 bench
+        pending = None
+        with ThreadPoolExecutor(1) as writer:
+            for block_id in job_config["block_list"]:
+                bb = blocking.get_block(block_id).bb
+                # the fused pass stages fragments in RAM (same process) —
+                # no store re-read on the flagship path (r3: 25.7 s)
+                ent = fragment_cache_get(cfg["input_path"],
+                                         cfg["input_key"], block_id,
+                                         expect_bb=bb)
+                if ent is not None:
+                    local, f_off, _ = ent
+                    if table.ndim == 1 and offsets is None:
+                        # fold the fragment offset into the table gather:
+                        # one pass over the block instead of three
+                        # (astype + offset add + gather)
+                        with stage("host-map"):
+                            out = table[np.add(
+                                local, np.uint64(f_off), dtype="uint64",
+                                where=local > 0,
+                                out=np.zeros(local.shape, "uint64"))]
+                        if pending is not None:
+                            pending.result()
+                        pending = writer.submit(_write, bb, out)
+                        log_fn(f"processed block {block_id}")
+                        continue
+                    seg = local.astype("uint64")
+                    seg[seg > 0] += np.uint64(f_off)
+                else:
+                    with stage("store-read"):
+                        seg = ds_in[bb].astype("uint64")
+                    stage_bytes("store-read", seg.nbytes)
+                if offsets is not None:
+                    off = np.uint64(offsets[block_id])
+                    seg[seg != 0] += off
+                with stage("host-map"):
+                    out = apply_assignment_table(seg, table)
+                if pending is not None:
+                    pending.result()  # depth-1 queue bounds memory
+                pending = writer.submit(_write, bb, out)
+                log_fn(f"processed block {block_id}")
+            if pending is not None:
+                pending.result()

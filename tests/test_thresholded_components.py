@@ -100,3 +100,53 @@ def test_empty_volume(tmp_workdir, tmp_path):
     assert build([wf], raise_on_failure=True)
     with file_reader(path, "r") as f:
         assert (f["cc"][...] == 0).all()
+
+
+def test_resident_cc_partition_identical(tmp_workdir, tmp_path, monkeypatch):
+    """The resident device pass (CTT_FORCE_RESIDENT exercises it on the
+    CPU backend) must produce the same partition as scipy and as the
+    classic chain."""
+    from cluster_tools_tpu.workflows.fused_pipeline import clear_caches
+
+    tmp_folder, config_dir = tmp_workdir
+    shape = (25, 30, 30)  # clipped border blocks included
+    vol = _make_volume(shape, seed=3)
+    threshold = 0.5
+
+    path = str(tmp_path / "data.n5")
+    with file_reader(path) as f:
+        ds = f.require_dataset("raw", shape=shape, chunks=(10, 10, 10),
+                               dtype="float32")
+        ds[...] = vol
+
+    monkeypatch.setenv("CTT_FORCE_RESIDENT", "1")
+    clear_caches()
+    wf = ThresholdedComponentsWorkflow(
+        input_path=path, input_key="raw", output_path=path,
+        output_key="cc_res", threshold=threshold, tmp_folder=tmp_folder,
+        config_dir=config_dir, max_jobs=2, target="tpu")
+    assert build([wf], raise_on_failure=True)
+
+    with file_reader(path, "r") as f:
+        result = f["cc_res"][...]
+        max_id = f["cc_res"].attrs["maxId"]
+
+    expected, n_exp = ndimage.label(vol > threshold)
+    assert _partitions_equal(result, expected.astype("uint64"))
+    assert max_id == n_exp
+
+    # cache-miss path (fresh process semantics): faces + write fall back
+    # to store reads and still agree
+    clear_caches()
+    import shutil
+
+    shutil.rmtree(tmp_folder, ignore_errors=True)
+    wf = ThresholdedComponentsWorkflow(
+        input_path=path, input_key="raw", output_path=path,
+        output_key="cc_res2", threshold=threshold,
+        tmp_folder=tmp_folder + "_2", config_dir=config_dir,
+        max_jobs=2, target="tpu")
+    assert build([wf], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        result2 = f["cc_res2"][...]
+    assert _partitions_equal(result2, expected.astype("uint64"))
