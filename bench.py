@@ -32,6 +32,16 @@ Two measurements:
   many-core machine the subvolume (or max_jobs) must be sized so the
   baseline saturates the same worker count as a full run would.
 
+VARIANCE-PROOFING (r6): both measurements are MEDIANS over >= 3 trials
+(``BENCH_TRIALS`` / ``BENCH_CPU_TRIALS`` env overrides).  The r5 headline
+was a single trial whose ``sync-meta`` wait swung 5x between identical
+runs — all one-time XLA compile mixed into execute waits.  The runtime
+now times those separately (``sync-compile`` vs ``sync-execute``), every
+trial's wall and per-stage breakdown is reported, and the CPU baseline is
+pinned the same way: fixed worker count, JAX_PLATFORMS=cpu subprocess,
+median over trials (its host-side throughput varies ~1.5x run-to-run on a
+shared core — the median, not one draw, is the denominator).
+
 Parity: BOTH chains must segment well in absolute terms — VOI, adapted
 Rand error and CREMI score against the generating ground truth are
 computed and reported for each (reference metric definitions:
@@ -53,9 +63,16 @@ import time
 
 import numpy as np
 
-SHAPE = (125, 1250, 1250)        # ~195 Mvox: one CREMI sample
-CPU_SHAPE = (50, 512, 1024)      # 2 reference blocks: CPU-baseline subvolume
-BLOCK = [50, 512, 512]           # reference default (cluster_tasks.py:217)
+def _env_shape(name, default):
+    val = os.environ.get(name)
+    return tuple(int(x) for x in val.split(",")) if val else default
+
+
+# env overrides exist for smoke-testing the harness on small hosts; the
+# recorded BENCH numbers always use the defaults
+SHAPE = _env_shape("BENCH_SHAPE", (125, 1250, 1250))   # ~195 Mvox: one CREMI sample
+CPU_SHAPE = _env_shape("BENCH_CPU_SHAPE", (50, 512, 1024))  # 2 reference blocks
+BLOCK = list(_env_shape("BENCH_BLOCK", (50, 512, 512)))  # reference default (cluster_tasks.py:217)
 CELL_DENSITY = 70000             # voxels per cell (round-2 bench density)
 
 
@@ -232,8 +249,18 @@ def metrics(seg, gt):
             "rand_error": round(float(are), 4), "cremi": round(float(cs), 4)}
 
 
+def _profile_rows(profile):
+    return [{"task": task, "wall_s": round(wall, 2),
+             "n_blocks": n_blocks, "device_busy_frac": dbf,
+             "stages": stages, "bytes_moved": mb}
+            for wall, task, n_blocks, stages, dbf, mb in profile]
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    n_trials = max(int(os.environ.get("BENCH_TRIALS", "3")), 1)
+    n_cpu_trials = max(int(os.environ.get("BENCH_CPU_TRIALS", "3")), 1)
 
     base = "/tmp/ctt_bench"
     shutil.rmtree(base, ignore_errors=True)
@@ -258,22 +285,51 @@ def main():
     n_cpu_voxels = int(np.prod(CPU_SHAPE))
 
     # device: subvolume first (pays most compiles + gives the same-data
-    # quality comparison), then full twice (second run = steady state)
+    # quality comparison), one full warm run (remaining one-time compiles,
+    # excluded from the timing like any deployment's warm-up), then
+    # n_trials timed steady-state runs — the MEDIAN is the headline
     _, dev_seg_sub = run_chain(cpu_store, CPU_SHAPE,
                                os.path.join(base, "dev_sub"), "tpu")
     run_chain(full_store, SHAPE, os.path.join(base, "dev_warm"), "tpu")
-    dev_t, dev_seg = run_chain(full_store, SHAPE,
-                               os.path.join(base, "dev_timed"), "tpu")
-    profile = task_profile(os.path.join(base, "dev_timed"))
-    for wall, task, n_blocks, stages, dbf, mb in profile[:8]:
-        stage_txt = " ".join(f"{k}={v:.1f}" for k, v in stages.items())
-        dbf_txt = f" dev_frac={dbf:.2f}" if dbf is not None else ""
-        print(f"  device task {task:40s} wall={wall:7.2f}s "
-              f"n_blocks={n_blocks}{dbf_txt} {stage_txt}",
+    dev_trials = []
+    dev_seg = None
+    for ti in range(n_trials):
+        workdir = os.path.join(base, f"dev_t{ti}")
+        dev_t, dev_seg = run_chain(full_store, SHAPE, workdir, "tpu")
+        profile = task_profile(workdir)
+        dev_trials.append({"wall_s": round(dev_t, 2),
+                           "vox_per_sec": round(n_voxels / dev_t, 1),
+                           "tasks": _profile_rows(profile)})
+        print(f"device trial {ti}: {dev_t:.1f}s "
+              f"({n_voxels/dev_t/1e6:.2f} Mvox/s)",
               file=sys.stderr, flush=True)
+        for wall, task, n_blocks, stages, dbf, mb in profile[:8]:
+            stage_txt = " ".join(f"{k}={v:.1f}" for k, v in stages.items())
+            dbf_txt = f" dev_frac={dbf:.2f}" if dbf is not None else ""
+            print(f"  device task {task:40s} wall={wall:7.2f}s "
+                  f"n_blocks={n_blocks}{dbf_txt} {stage_txt}",
+                  file=sys.stderr, flush=True)
+        if ti < n_trials - 1:
+            shutil.rmtree(workdir, ignore_errors=True)  # bound disk
+    # headline and breakdown must come from the SAME run: take the middle
+    # trial by wall (for even trial counts np.median would interpolate a
+    # wall no trial actually had, irreconcilable with its stage table)
+    dev_walls = [t["wall_s"] for t in dev_trials]
+    median_trial = dev_trials[int(np.argsort(dev_walls)[len(dev_walls) // 2])]
+    dev_t = float(median_trial["wall_s"])
 
-    cpu_t, cpu_seg = run_cpu_chain_subprocess(cpu_store, CPU_SHAPE,
-                                              os.path.join(base, "cpu"))
+    # pinned CPU baseline: same fixed worker count and JAX_PLATFORMS=cpu
+    # subprocess every trial; the median absorbs the ~1.5x host-side
+    # throughput swings of a shared core
+    cpu_walls = []
+    cpu_seg = None
+    for ti in range(n_cpu_trials):
+        cpu_t_i, cpu_seg = run_cpu_chain_subprocess(
+            cpu_store, CPU_SHAPE, os.path.join(base, f"cpu_t{ti}"))
+        cpu_walls.append(round(cpu_t_i, 2))
+        print(f"cpu trial {ti}: {cpu_t_i:.1f}s", file=sys.stderr, flush=True)
+        shutil.rmtree(os.path.join(base, f"cpu_t{ti}"), ignore_errors=True)
+    cpu_t = float(sorted(cpu_walls)[len(cpu_walls) // 2])
 
     gt = np.load(gt_path)
     dev_m = metrics(dev_seg, gt)
@@ -284,48 +340,89 @@ def main():
                           - (cpu_m["voi_split"] + cpu_m["voi_merge"])), 4)
 
     peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
-    print(f"device full: {dev_t:.1f}s {dev_m}; cpu baseline "
-          f"({n_cpu_voxels/1e6:.0f} Mvox subvolume): {cpu_t:.1f}s {cpu_m}; "
-          f"device-on-subvolume {dev_sub_m}; peak RSS {peak_rss_gb:.1f} GB",
+    print(f"device full (median of {n_trials}): {dev_t:.1f}s {dev_m}; cpu "
+          f"baseline ({n_cpu_voxels/1e6:.0f} Mvox subvolume, median of "
+          f"{n_cpu_trials}): {cpu_t:.1f}s {cpu_m}; device-on-subvolume "
+          f"{dev_sub_m}; peak RSS {peak_rss_gb:.1f} GB",
           file=sys.stderr, flush=True)
 
     # quality gates: both chains must segment well in absolute terms, and
-    # the algorithm-family difference must stay small on identical data
+    # the algorithm-family difference must stay inside the VOI parity
+    # budget on identical data (acceptance: same-data delta <= 0.01).
+    # Smoke-sized env-override volumes hold too few cells for the delta
+    # to be meaningful — only the absolute gates apply there
+    smoke = any(os.environ.get(v) for v in
+                ("BENCH_SHAPE", "BENCH_CPU_SHAPE", "BENCH_BLOCK"))
     assert dev_m["rand_error"] < 0.1, f"device lost parity: {dev_m}"
     assert cpu_m["rand_error"] < 0.1, f"cpu baseline lost parity: {cpu_m}"
-    assert voi_delta < 0.25, f"device<->cpu VOI delta too large: {voi_delta}"
-    # memory stays bounded: streamed block windows, not volume-sized
-    # device/host buffers (input volume alone is ~0.78 GB float32)
-    assert peak_rss_gb < 8.0, f"peak RSS {peak_rss_gb:.1f} GB unbounded?"
+    assert voi_delta <= (0.25 if smoke else 0.01), \
+        f"device<->cpu VOI delta too large: {voi_delta}"
+    # memory stays bounded: streamed block windows + bounded writer-pool
+    # backpressure, not volume-sized device/host buffers
+    assert peak_rss_gb < 7.0, f"peak RSS {peak_rss_gb:.1f} GB unbounded?"
 
     value = n_voxels / dev_t
     baseline = n_cpu_voxels / cpu_t
-    print(json.dumps({
+    # the FULL report (every trial's wall + per-stage/per-task breakdown,
+    # bytes moved) goes to a file; stdout carries one COMPACT JSON line —
+    # the harness that records bench output keeps only the last ~2000
+    # characters, and the r5 line outgrew that and became unparseable
+    full = {
         "metric": "multicut_workflow_throughput",
         "value": round(value, 1),
         "unit": "voxels/sec",
         "vs_baseline": round(value / baseline, 3),
         "volume_mvox": round(n_voxels / 1e6, 1),
         "block_shape": BLOCK,
+        "n_trials": n_trials,
+        "trial_walls_s": dev_walls,
         "baseline_vox_per_sec": round(baseline, 1),
+        "baseline_trial_walls_s": cpu_walls,
         "baseline_note": ("reference-faithful scipy chain, target='local', "
                           f"{n_cpu_voxels/1e6:.0f} Mvox subvolume, "
-                          "per-voxel extrapolated; host-side throughput "
-                          "varies ~1.5x run-to-run on this shared single "
-                          "core (r4 observed 332-509 kvox/s) while the "
-                          "device throughput is stable — compare the "
-                          "absolute value across rounds"),
+                          "per-voxel extrapolated, median of "
+                          f"{n_cpu_trials} pinned trials (fixed worker "
+                          "count, JAX_PLATFORMS=cpu)"),
         "device": dev_m, "cpu": cpu_m, "device_on_cpu_subvolume": dev_sub_m,
         "voi_delta_same_data": voi_delta,
         "peak_rss_gb": round(peak_rss_gb, 2),
-        # per-task utilization: accelerator-path share of each task's
-        # wall (device compute + link transfers, one serialized resource
-        # on tunnel backends) + the bytes each stage moved — where the
-        # chip idles is now measured, not guessed (VERDICT r4 item 8)
-        "tasks": [{"task": task, "wall_s": round(wall, 2),
-                   "n_blocks": n_blocks, "device_busy_frac": dbf,
-                   "stages": stages, "bytes_moved": mb}
-                  for wall, task, n_blocks, stages, dbf, mb in profile],
+        # per-task utilization of the MEDIAN trial (one-time XLA builds
+        # split out as sync-compile vs steady-state sync-execute) + every
+        # trial's full breakdown: progress claims must survive the
+        # variance the r5 single-trial headline hid
+        "tasks": median_trial["tasks"],
+        "trials": dev_trials,
+    }
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r06_full.json")
+    with open(detail_path, "w") as f:
+        json.dump(full, f, indent=1)
+    print(f"full per-trial report: {detail_path}", file=sys.stderr,
+          flush=True)
+
+    by_task = {r["task"]: r for r in median_trial["tasks"]}
+    fused = by_task.get("fused_segmentation", {})
+    wmc = by_task.get("write_multicut", {})
+    print(json.dumps({
+        "metric": "multicut_workflow_throughput",
+        "value": round(value, 1),
+        "unit": "voxels/sec",
+        "vs_baseline": round(value / baseline, 3),
+        "volume_mvox": round(n_voxels / 1e6, 1),
+        "n_trials": n_trials,
+        "trial_walls_s": dev_walls,
+        "baseline_vox_per_sec": round(baseline, 1),
+        "baseline_trial_walls_s": cpu_walls,
+        "device": dev_m, "cpu": cpu_m,
+        "voi_delta_same_data": voi_delta,
+        "peak_rss_gb": round(peak_rss_gb, 2),
+        "fused_wall_s": fused.get("wall_s"),
+        "fused_stages": {k: round(v, 1) for k, v in
+                         (fused.get("stages") or {}).items()},
+        "write_multicut_wall_s": wmc.get("wall_s"),
+        "write_multicut_stages": {k: round(v, 1) for k, v in
+                                  (wmc.get("stages") or {}).items()},
+        "detail": os.path.basename(detail_path),
     }))
 
 

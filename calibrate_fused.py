@@ -167,10 +167,18 @@ def main():
 
     prefices = build_prefices(outer_shape, tuple(HALO))
     cum = []
+    compile_s = {}
     for name, prog in prefices:
-        # warmup (compile) on each distinct block shape/value
+        # warmup on each distinct block shape/value; the FIRST call pays
+        # the XLA build — record it so the per-stage table separates
+        # compile from steady-state execute, mirroring the runtime's
+        # sync-compile / sync-execute stage split
+        first = None
         for b in blocks:
+            t0 = time.perf_counter()
             jax.block_until_ready(prog(b))
+            if first is None:
+                first = time.perf_counter() - t0
         ts = []
         for _ in range(args.reps):
             for b in blocks:
@@ -178,7 +186,9 @@ def main():
                 jax.block_until_ready(prog(b))
                 ts.append(time.perf_counter() - t0)
         cum.append((name, float(np.median(ts))))
-        print(f"  cumulative through {name:<14s} {np.median(ts):7.3f}s")
+        compile_s[name] = round(max(first - float(np.median(ts)), 0.0), 3)
+        print(f"  cumulative through {name:<14s} {np.median(ts):7.3f}s "
+              f"(compile ~{compile_s[name]:.1f}s)")
 
     print("\nper-stage device time (marginal):")
     table = {}
@@ -195,6 +205,7 @@ def main():
         with open(args.json, "w") as f:
             json.dump({"outer_shape": list(outer_shape),
                        "cumulative": dict(cum), "per_stage": table,
+                       "compile_s": compile_s,
                        "total_s": cum[-1][1]}, f, indent=1)
 
 

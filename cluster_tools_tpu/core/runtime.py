@@ -59,7 +59,12 @@ _STAGE_LOCK = threading.Lock()
 #: stage-name prefixes attributed to the ACCELERATOR PATH (device compute
 #: + link transfers, which the tunnel serializes) when computing the
 #: per-task device_busy_frac in the status JSON — the chip-utilization
-#: observability the bench emits (VERDICT r4 item 8)
+#: observability the bench emits (VERDICT r4 item 8).  Device tasks split
+#: their program wait into ``sync-compile`` (one-time XLA builds) and
+#: ``sync-execute`` (steady-state waits): the two have 5x-different
+#: variance and lumping them made the bench headline a coin flip
+#: (BENCH_r05).  Host-side algorithm stages (union-find scans, table
+#: gathers) use ``host-`` names so they never inflate device_busy_frac.
 _DEVICE_STAGE_PREFIXES = ("sync-", "d2h-", "h2d-", "dispatch", "cap-retry",
                           "device-")
 
@@ -219,6 +224,83 @@ def prefetch_iter(items, load, window: int = 2):
     with ThreadPoolExecutor(max_workers=window) as pool:
         yield from stream_window(items, lambda it: pool.submit(load, it),
                                  lambda fut: fut.result(), window=window)
+
+
+class BoundedPool:
+    """Thread pool with BOUNDED in-flight futures — the async-drain hook
+    for blockwise device tasks.  Drains hand per-block host tails (RLE
+    decode, table gather, store write — tensorstore/z5 release the GIL)
+    to the pool and immediately return to waiting on the next device
+    program; ``submit`` blocks once ``max_inflight`` results are pending,
+    so queued blocks (each holding a ~100 MB uint64 write buffer) cannot
+    grow RSS unboundedly.  ``max_workers=0`` degrades to synchronous
+    inline calls — the sequential-drain reference mode the pipelined path
+    must match bit-identically (tests/test_write_pipelined.py).
+
+    Worker exceptions surface on the next ``submit`` or at ``close()``
+    (context-manager exit), never silently."""
+
+    def __init__(self, max_workers: int, max_inflight: Optional[int] = None):
+        from collections import deque
+
+        self.max_workers = int(max_workers)
+        self.max_inflight = (max(int(max_inflight), 1) if max_inflight
+                             else max(2 * self.max_workers, 1))
+        self._pool = (ThreadPoolExecutor(self.max_workers)
+                      if self.max_workers > 0 else None)
+        self._pending = deque()
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        if self._pool is None:
+            fn(*args, **kwargs)
+            return
+        while len(self._pending) >= self.max_inflight:
+            self._pending.popleft().result()
+        self._pending.append(self._pool.submit(fn, *args, **kwargs))
+
+    def drain(self) -> None:
+        """Wait for every pending task, surfacing the first failure."""
+        while self._pending:
+            self._pending.popleft().result()
+
+    def close(self) -> None:
+        try:
+            self.drain()
+        finally:
+            if self._pool is not None:
+                self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if exc and exc[0] is not None:
+            # already failing: don't mask the original error with a
+            # secondary worker failure during cleanup
+            if self._pool is not None:
+                self._pool.shutdown(wait=True)
+            return False
+        self.close()
+        return False
+
+
+def writer_pool(cfg: Dict[str, Any], ds_out,
+                default_threads: int = 4,
+                sequential: bool = False) -> "BoundedPool":
+    """The configured store-writer BoundedPool for a blockwise task: sized
+    by the ``writer_threads`` task config (0 = strictly sequential inline
+    mode), capped at one worker for h5py datasets (h5py is not
+    thread-safe; tensorstore-backed N5/zarr chunks write in parallel),
+    and forced fully sequential when the caller requires ordered
+    read-then-write semantics (e.g. in-place writes, where an overlapped
+    write can tear a chunk spanning two blocks).  In-flight work is
+    bounded at workers + 1 so queued blocks cannot grow RSS unboundedly."""
+    n = int(cfg.get("writer_threads", default_threads))
+    if getattr(ds_out, "flavor", "h5") == "h5":
+        n = min(n, 1)
+    if sequential:
+        n = 0
+    return BoundedPool(n, max_inflight=n + 1)
 
 
 def stream_window(items, submit, drain, window: int = 3):
@@ -736,9 +818,13 @@ class BlockTask(Task):
         # accelerator-path share of the task wall: device compute + link
         # transfers (one serialized resource on tunnel backends).  The
         # complement is host compute + store IO + scheduling — where the
-        # chip idles (VERDICT r4: rounds were being steered blind here)
+        # chip idles (VERDICT r4: rounds were being steered blind here).
+        # Stages timed in overlapped pool workers use non-device names
+        # (fetch-*, host-*); the clamp below keeps the ratio meaningful
+        # even if overlapping device-prefixed stages ever double-count
         device_time = sum(v for k, v in stages.items()
                           if k.startswith(_DEVICE_STAGE_PREFIXES))
+        device_time = min(device_time, elapsed)
         status = {
             "task": self.name_with_id,
             "n_jobs": n_jobs,

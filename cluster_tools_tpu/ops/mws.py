@@ -294,6 +294,30 @@ def _sorted_edges_resident_impl(vol, origin, seeds,
     return u_sorted, vp_sorted, affs.sum()
 
 
+def compact_seeds_int32(seeds: np.ndarray) -> np.ndarray:
+    """Equality-preserving block-local relabel of seed ids to int32.
+
+    The seeded pass-2 device path feeds uint64 GLOBAL labels
+    (``block_id * offset_unit + 1 + rank``) as seeds; a plain
+    ``astype('int32')`` wraps once ``block_id * offset_unit > 2^31``
+    (~112 blocks at bench sizes), colliding distinct seeds (false
+    ``su == sv`` boosts -> wrong merges) or wrapping a seed to 0 (seed
+    lost).  Only EQUALITY matters inside ``_sorted_edges_device``, so a
+    dense block-local relabel is exact: 0 (unseeded) stays 0, distinct
+    ids stay distinct, and the result always fits int32 (a block holds
+    < 2^29 voxels, enforced below)."""
+    s = np.asarray(seeds)
+    if s.size == 0 or int(s.max()) < (1 << 31):
+        # common case (volumes below ~112 blocks): the cast is already
+        # exact — skip the O(n log n) unique over the outer block
+        return s.astype("int32")
+    uniq, inv = np.unique(s, return_inverse=True)
+    inv = inv.astype("int32").reshape(s.shape)
+    if uniq.size and uniq[0] == 0:
+        return inv
+    return inv + 1  # no zeros present: keep every id nonzero
+
+
 def _sorted_edges_resident(affs_dev, origin, outer_shape,
                            offsets, strides,
                            seeds: Optional[np.ndarray] = None):
@@ -306,8 +330,16 @@ def _sorted_edges_resident(affs_dev, origin, outer_shape,
     without a separate download."""
     import jax.numpy as jnp
 
+    if int(np.prod(outer_shape)) >= (1 << 29):
+        # v_packed carries the partner voxel index in bits 0-28 (flags at
+        # 29/30): a larger outer block would silently corrupt the edge
+        # stream.  Callers route oversized blocks to the host path
+        raise ValueError(
+            f"outer block {tuple(outer_shape)} has >= 2^29 voxels — the "
+            "packed edge stream cannot address it; use the host path or "
+            "shrink blocks")
     seeded = seeds is not None
-    seeds_in = (jnp.asarray(np.asarray(seeds).astype("int32"))
+    seeds_in = (jnp.asarray(compact_seeds_int32(seeds))
                 if seeded else jnp.zeros((1,) * len(outer_shape), jnp.int32))
     return _sorted_edges_resident_impl(
         affs_dev, jnp.asarray(origin, dtype=jnp.int32), seeds_in,
@@ -316,18 +348,15 @@ def _sorted_edges_resident(affs_dev, origin, outer_shape,
         tuple(int(s) for s in strides), seeded)
 
 
-def mutex_watershed_finalize_sorted(handles, shape, asum=None,
-                                    mask: Optional[np.ndarray] = None):
-    """Download one block's sorted edge stream and run the host scan.
-    Returns (labels, affinity_sum): uint64 labels consecutive from 1
-    (0 on masked voxels); when ``asum`` (a device handle) reports an
-    all-zero block the scan is skipped and labels is None."""
-    u_sorted, vp_sorted = handles
-    a = float(np.asarray(asum)) if asum is not None else None
-    if a == 0.0:
-        return None, 0.0
-    u = np.asarray(u_sorted)
-    vp = np.asarray(vp_sorted)
+def mutex_watershed_scan_sorted(u, vp, shape,
+                                mask: Optional[np.ndarray] = None):
+    """Host half of the sorted finalize: the C++ union-find scan over a
+    DOWNLOADED sorted edge stream; returns uint64 labels consecutive
+    from 1 (0 on masked voxels).  Split from the downloads so pipelining
+    callers can attribute the link transfer (``d2h-edges``) and this
+    sequential host scan (``host-scan``) to separate stages — lumping
+    both under a ``sync-`` stage mis-credited the host scan to the
+    accelerator path (ADVICE r5)."""
     dropped = (vp >> 29) & 1
     u = np.where(dropped != 0, np.int32(-1), u)
     v = vp & np.int32((1 << 29) - 1)
@@ -344,6 +373,22 @@ def mutex_watershed_finalize_sorted(handles, shape, asum=None,
         labels = inv.reshape(shape).astype("uint64")
     else:
         labels = (inv.reshape(shape) + 1).astype("uint64")
+    return labels
+
+
+def mutex_watershed_finalize_sorted(handles, shape, asum=None,
+                                    mask: Optional[np.ndarray] = None):
+    """Download one block's sorted edge stream and run the host scan.
+    Returns (labels, affinity_sum): uint64 labels consecutive from 1
+    (0 on masked voxels); when ``asum`` (a device handle) reports an
+    all-zero block the scan is skipped and labels is None."""
+    u_sorted, vp_sorted = handles
+    a = float(np.asarray(asum)) if asum is not None else None
+    if a == 0.0:
+        return None, 0.0
+    labels = mutex_watershed_scan_sorted(np.asarray(u_sorted),
+                                         np.asarray(vp_sorted), shape,
+                                         mask=mask)
     return labels, (a if a is not None else 1.0)
 
 

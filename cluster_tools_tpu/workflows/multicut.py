@@ -125,14 +125,17 @@ class SolveSubproblems(BlockTask):
                      uv_dense: np.ndarray, costs: np.ndarray) -> np.ndarray:
         """Hook: solve one block's subproblem -> labeling over the block's
         local (unique-compacted) nodes' cut mask; returns inner cut ids."""
+        from ..core.runtime import stage
+
         agglomerator = key_to_agglomerator(
             cfg.get("agglomerator", "kernighan-lin"))
         sub_uv = uv_dense[inner]
         sub_nodes, local_uv_flat = np.unique(sub_uv, return_inverse=True)
         local_uv = local_uv_flat.reshape(-1, 2).astype("int64")
         sub_costs = costs[inner]
-        sub_res = agglomerator(len(sub_nodes), local_uv, sub_costs,
-                               time_limit=cfg.get("time_limit_solver"))
+        with stage("host-solve"):
+            sub_res = agglomerator(len(sub_nodes), local_uv, sub_costs,
+                                   time_limit=cfg.get("time_limit_solver"))
         cut_mask = sub_res[local_uv[:, 0]] != sub_res[local_uv[:, 1]]
         return inner[cut_mask]
 
@@ -241,7 +244,10 @@ class ReduceProblem(BlockTask):
         log_fn(f"merging {int(merge_mask.sum())} / {len(uv_dense)} edges")
 
         # union-find merge of uncut edges -> consecutive node labeling
-        roots = native.ufd_merge_pairs(n_nodes, uv_dense[merge_mask])
+        from ..core.runtime import stage
+
+        with stage("host-reduce"):
+            roots = native.ufd_merge_pairs(n_nodes, uv_dense[merge_mask])
         _, node_labeling = np.unique(roots, return_inverse=True)
         node_labeling = node_labeling.astype("uint64")
         n_new_nodes = int(node_labeling.max()) + 1 if n_nodes else 0
@@ -348,10 +354,13 @@ class SolveGlobal(BlockTask):
         agglomerator = key_to_agglomerator(
             cfg.get("agglomerator", "kernighan-lin"))
 
+        from ..core.runtime import stage
+
         uv_dense, n_nodes, s0_nodes = _load_scale_graph(problem_path, scale)
         costs = _load_costs(problem_path, scale)
-        labels = agglomerator(n_nodes, uv_dense.astype("int64"), costs,
-                              time_limit=cfg.get("time_limit_solver"))
+        with stage("host-solve"):
+            labels = agglomerator(n_nodes, uv_dense.astype("int64"), costs,
+                                  time_limit=cfg.get("time_limit_solver"))
         log_fn(f"global solve: {n_nodes} nodes -> "
                f"{len(np.unique(labels))} segments")
 

@@ -35,14 +35,37 @@ from .relabel import RelabelWorkflow
 from .write import WriteAssignments
 
 
-def normalize(data: np.ndarray) -> np.ndarray:
+def normalize(data: np.ndarray,
+              mx: Optional[float] = None) -> np.ndarray:
     """Affinities to float32 in [0, 1]; integer dtypes scale by their dtype
-    range (reference vu.normalize, utils/volume_utils.py:113-120)."""
+    range (reference vu.normalize, utils/volume_utils.py:113-120).
+
+    ``mx`` pins the scale for float inputs: blockwise callers MUST pass
+    the volume-global max so per-block normalization matches the
+    device-resident path, which normalizes the whole volume at once —
+    otherwise ``impl='auto'`` changes segmentation results by backend
+    (ADVICE r5)."""
     if np.issubdtype(data.dtype, np.integer):
         return data.astype("float32") / np.iinfo(data.dtype).max
     data = data.astype("float32")
-    mx = data.max()
-    return data / mx if mx > 1.0 else data
+    mx = float(data.max()) if mx is None else float(mx)
+    return data / np.float32(mx) if mx > 1.0 else data
+
+
+def _chunked_max(ds, slab_voxels: int = 1 << 26) -> float:
+    """Volume-global max with BOUNDED memory: one z-slab of the (channel,
+    z, y, x) dataset at a time — never the full volume (the blockwise
+    host path exists precisely for volumes that do not fit in RAM)."""
+    shape = tuple(ds.shape)
+    if 0 in shape:
+        return 0.0
+    per_row = int(np.prod(shape[:1] + shape[2:]))
+    rows = max(int(slab_voxels // max(per_row, 1)), 1)
+    mx = -np.inf
+    for z0 in range(0, shape[1], rows):
+        z1 = min(z0 + rows, shape[1])  # tensorstore rejects overruns
+        mx = max(mx, float(np.max(ds[(slice(None), slice(z0, z1))])))
+    return mx
 
 
 class MwsBlocksBase(BlockTask):
@@ -74,8 +97,20 @@ class MwsBlocksBase(BlockTask):
         return conf
 
     def run_impl(self):
+        global_max = None
         with file_reader(self.input_path, "r") as f:
-            shape = list(f[self.input_key].shape)
+            ds = f[self.input_key]
+            shape = list(ds.shape)
+            if (self.task_config.get("impl") == "host"
+                    and not np.issubdtype(np.dtype(ds.dtype), np.integer)):
+                # normalization parity (ADVICE r5): float inputs need the
+                # VOLUME-global max so per-block host normalization
+                # matches the device-resident path.  One chunked scan in
+                # the driver, reused by every worker job via the config —
+                # but only when the host path is pinned; under 'auto' the
+                # device path may win and computes its own volume max, so
+                # host-path workers fall back to a lazy per-job scan
+                global_max = _chunked_max(ds)
         assert len(shape) == 4, "need 4d (channel, spatial...) input for MWS"
         n_channels, shape = shape[0], shape[1:]
         assert n_channels == len(self.offsets), (n_channels, len(self.offsets))
@@ -94,7 +129,7 @@ class MwsBlocksBase(BlockTask):
             "offsets": self.offsets, "halo": self.halo,
             "mask_path": self.mask_path, "mask_key": self.mask_key,
             "shape": shape, "block_shape": block_shape,
-            "seeded": self.seeded,
+            "seeded": self.seeded, "global_max": global_max,
         }, n_jobs=self.max_jobs)
 
     @classmethod
@@ -114,6 +149,13 @@ class MwsBlocksBase(BlockTask):
 
             mask = load_mask(cfg["mask_path"], cfg["mask_key"], cfg["shape"])
 
+        # the per-block id budget must cover the halo-enlarged outer block:
+        # labels are compacted over the full outer region so halo-only
+        # segments keep valid global ids for the seed assignments
+        outer_shape = (cfg["block_shape"] if halo is None else
+                       [b + 2 * h for b, h in zip(cfg["block_shape"], halo)])
+        offset_unit = int(np.prod(outer_shape))
+
         impl = cfg.get("impl", "auto")
         if impl == "auto":
             import jax
@@ -126,15 +168,26 @@ class MwsBlocksBase(BlockTask):
                                  and not cfg.get("noise_level")
                                  and not cfg.get("randomize_strides"))
                     else "host")
+        if impl == "device" and offset_unit >= (1 << 29):
+            # the device edge stream packs partner indices into 29 bits
+            # (ops/mws._sorted_edges_device); oversized outer blocks
+            # route to the always-correct host path (ADVICE r5)
+            log_fn(f"outer block of {offset_unit} voxels exceeds the "
+                   "2^29 packed-edge budget; using the host path")
+            impl = "host"
         if impl == "device":
             return cls._process_device_sorted(job_config, log_fn, blocking,
                                               ds_in, ds_out, cfg)
-        # the per-block id budget must cover the halo-enlarged outer block:
-        # labels are compacted over the full outer region so halo-only
-        # segments keep valid global ids for the seed assignments
-        outer_shape = (cfg["block_shape"] if halo is None else
-                       [b + 2 * h for b, h in zip(cfg["block_shape"], halo)])
-        offset_unit = int(np.prod(outer_shape))
+
+        # normalization parity with the device-resident path (which
+        # normalizes the WHOLE volume at once): float inputs need the
+        # volume-global max — from the driver's scan when impl='host' was
+        # pinned (run_impl), else one lazy chunked scan per job; integer
+        # scaling is block-independent already
+        global_mx = cfg.get("global_max")
+        if global_mx is None and not np.issubdtype(
+                np.dtype(ds_in.dtype), np.integer):
+            global_mx = _chunked_max(ds_in)
 
         for block_id in job_config["block_list"]:
             if halo is None:
@@ -151,7 +204,8 @@ class MwsBlocksBase(BlockTask):
                 if not bb_mask.any():
                     log_fn(f"processed block {block_id}")
                     continue
-            affs = normalize(ds_in[(slice(None),) + outer_bb])
+            affs = normalize(ds_in[(slice(None),) + outer_bb],
+                             mx=global_mx)
             if affs.sum() == 0:
                 log_fn(f"processed block {block_id}")
                 continue
@@ -218,7 +272,7 @@ class MwsBlocksBase(BlockTask):
         import jax.numpy as jnp
 
         from ..core.runtime import stage, stage_bytes
-        from ..ops.mws import (mutex_watershed_finalize_sorted,
+        from ..ops.mws import (mutex_watershed_scan_sorted,
                                _sorted_edges_resident)
 
         halo = cfg["halo"]
@@ -280,13 +334,22 @@ class MwsBlocksBase(BlockTask):
         def drain(block_id, handles, seeds):
             outer_bb, inner_bb, local_bb = block_meta[block_id]
             shape_o = outer_shape_of[block_id]
-            with stage("sync-meta"):
-                seg, asum = mutex_watershed_finalize_sorted(
-                    handles[:2], shape_o, asum=handles[2])
-            stage_bytes("sync-meta", int(np.prod(shape_o)) * 8)
+            # three separately-attributed phases: the wait for the device
+            # sort (sync-execute), the edge-stream download (d2h-edges),
+            # and the sequential host C++ union-find scan (host-scan) —
+            # previously one 'sync-meta' stage that credited the host
+            # scan to the accelerator path (ADVICE r5)
+            with stage("sync-execute"):
+                asum = float(np.asarray(handles[2]))
             if asum == 0.0:
                 log_fn(f"processed block {block_id}")
                 return
+            with stage("d2h-edges"):
+                u = np.asarray(handles[0])
+                vp = np.asarray(handles[1])
+            stage_bytes("d2h-edges", u.nbytes + vp.nbytes)
+            with stage("host-scan"):
+                seg = mutex_watershed_scan_sorted(u, vp, shape_o)
             nonzero = np.unique(seg[seg > 0])
             if len(nonzero) >= offset_unit:
                 raise RuntimeError(

@@ -304,7 +304,7 @@ class ResidentBlockComponents(BlockTask):
             block = blocking.get_block(bid)
             real = tuple(slice(0, e - b) for b, e in zip(block.begin,
                                                          block.end))
-            with stage("sync-meta"):
+            with stage("sync-execute"):
                 meta = np.asarray(meta_d)
             k_i, n_rle, rle_ok = (int(x) for x in meta)
             if rle_ok:

@@ -92,7 +92,9 @@ class WriteAssignments(BlockTask):
     @staticmethod
     def default_task_config():
         conf = BlockTask.default_task_config()
-        conf.update({"chunks": None})
+        # writer_threads sizes the map+write pool (0 = strictly
+        # sequential; forced to 0 for in-place writes, 1 for HDF5)
+        conf.update({"chunks": None, "writer_threads": 4})
         return conf
 
     def run_impl(self):
@@ -131,7 +133,7 @@ class WriteAssignments(BlockTask):
     def process_job(cls, job_id: int, job_config: Dict[str, Any], log_fn):
         import time
 
-        from ..core.runtime import stage, stage_add, stage_bytes
+        from ..core.runtime import stage, stage_add, stage_bytes, writer_pool
 
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
@@ -146,8 +148,6 @@ class WriteAssignments(BlockTask):
         f_out = f_in if in_place else file_reader(cfg["output_path"])
         ds_in, ds_out = f_in[cfg["input_key"]], f_out[cfg["output_key"]]
 
-        from concurrent.futures import ThreadPoolExecutor
-
         from .fused_pipeline import fragment_cache_get
 
         def _write(bb, out):
@@ -156,11 +156,39 @@ class WriteAssignments(BlockTask):
             stage_add("store-write", time.perf_counter() - t0)
             stage_bytes("store-write", out.nbytes)
 
-        # one writer thread: tensorstore's gzip+IO (GIL released) overlaps
-        # the next block's table gather — the final write was a fully
-        # serial ~10 s tail after the (0.3 s) solve in the r4 bench
-        pending = None
-        with ThreadPoolExecutor(1) as writer:
+        def _map_cached(block_id, bb, local, f_off):
+            """Fused-drain write path: gather the block's assignments
+            through a BLOCK-LOCAL slice of the table (k+1 entries, cache
+            resident) over the staged uint16/32 fragments — one pass over
+            the output instead of three volume-sized temporaries
+            (offset-add, zeros, global gather), and no store re-read."""
+            with stage("host-map"):
+                k = int(local.max())
+                if f_off + k >= table.size:
+                    raise ValueError(
+                        f"fragment id {f_off + k} outside assignment "
+                        f"table of size {table.size}")
+                lut = np.empty(k + 1, "uint64")
+                lut[0] = table[0]  # background
+                lut[1:] = table[f_off + 1:f_off + k + 1]
+                out = lut[local]
+            _write(bb, out)
+            log_fn(f"processed block {block_id}")
+
+        def _map_general(block_id, bb, seg):
+            with stage("host-map"):
+                out = apply_assignment_table(seg, table)
+            _write(bb, out)
+            log_fn(f"processed block {block_id}")
+
+        # sized writer pool: tensorstore's gzip+IO releases the GIL, so N
+        # blocks compress/write concurrently while the main thread walks
+        # the cache — the final write was a fully serial ~10 s tail after
+        # the (0.3 s) solve in the r4/r5 benches.  In-place jobs run
+        # strictly sequentially: overlapping the write of block i with
+        # the read of block i+1 can tear a chunk spanning both blocks
+        # when the chunk grid is not block-aligned (ADVICE r5)
+        with writer_pool(cfg, ds_out, sequential=in_place) as pool:
             for block_id in job_config["block_list"]:
                 bb = blocking.get_block(block_id).bb
                 # the fused pass stages fragments in RAM (same process) —
@@ -168,22 +196,13 @@ class WriteAssignments(BlockTask):
                 ent = fragment_cache_get(cfg["input_path"],
                                          cfg["input_key"], block_id,
                                          expect_bb=bb)
+                if ent is not None and table.ndim == 1 and offsets is None:
+                    local, f_off, _ = ent
+                    pool.submit(_map_cached, block_id, bb, local,
+                                int(f_off))
+                    continue
                 if ent is not None:
                     local, f_off, _ = ent
-                    if table.ndim == 1 and offsets is None:
-                        # fold the fragment offset into the table gather:
-                        # one pass over the block instead of three
-                        # (astype + offset add + gather)
-                        with stage("host-map"):
-                            out = table[np.add(
-                                local, np.uint64(f_off), dtype="uint64",
-                                where=local > 0,
-                                out=np.zeros(local.shape, "uint64"))]
-                        if pending is not None:
-                            pending.result()
-                        pending = writer.submit(_write, bb, out)
-                        log_fn(f"processed block {block_id}")
-                        continue
                     seg = local.astype("uint64")
                     seg[seg > 0] += np.uint64(f_off)
                 else:
@@ -193,11 +212,4 @@ class WriteAssignments(BlockTask):
                 if offsets is not None:
                     off = np.uint64(offsets[block_id])
                     seg[seg != 0] += off
-                with stage("host-map"):
-                    out = apply_assignment_table(seg, table)
-                if pending is not None:
-                    pending.result()  # depth-1 queue bounds memory
-                pending = writer.submit(_write, bb, out)
-                log_fn(f"processed block {block_id}")
-            if pending is not None:
-                pending.result()
+                pool.submit(_map_general, block_id, bb, seg)

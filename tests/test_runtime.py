@@ -210,3 +210,37 @@ def test_log_parsing_helpers(tmp_path):
     assert runtime.parse_processed_blocks(lp) == {3, 7}
     rt = runtime.parse_job_runtime(lp)
     assert rt is not None and abs(rt - 9.0) < 1.0
+
+
+def test_bounded_pool_inline_and_threaded():
+    """BoundedPool(0) runs inline (sequential reference mode); a threaded
+    pool completes everything by close() and bounds in-flight futures."""
+    from cluster_tools_tpu.core.runtime import BoundedPool
+
+    done = []
+    with BoundedPool(0) as pool:
+        pool.submit(done.append, 1)
+        assert done == [1]  # synchronous: visible immediately
+
+    results = []
+    with BoundedPool(2, max_inflight=3) as pool:
+        for i in range(20):
+            pool.submit(results.append, i)
+            assert len(pool._pending) <= 3
+    assert sorted(results) == list(range(20))
+
+
+def test_bounded_pool_surfaces_worker_errors():
+    from cluster_tools_tpu.core.runtime import BoundedPool
+
+    def boom():
+        raise RuntimeError("worker failed")
+
+    with pytest.raises(RuntimeError, match="worker failed"):
+        with BoundedPool(1) as pool:
+            pool.submit(boom)
+
+    # inline mode raises at the submit itself
+    pool = BoundedPool(0)
+    with pytest.raises(RuntimeError, match="worker failed"):
+        pool.submit(boom)

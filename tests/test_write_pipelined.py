@@ -1,0 +1,255 @@
+"""The pipelined fused drain must be an OPTIMIZATION, never a semantic:
+bit-identical fragments/tables vs the sequential drain, and the fused
+(cache-fed, LUT-gather) write path must match apply_assignment_table."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.core.storage import file_reader
+from cluster_tools_tpu.core.workflow import build
+
+
+def _instance(shape, n_cells=10, seed=0):
+    from scipy import ndimage
+
+    rng = np.random.RandomState(seed)
+    pts = rng.rand(n_cells, 3) * np.array(shape)
+    grids = np.meshgrid(*[np.arange(s) for s in shape], indexing="ij")
+    coords = np.stack([g.ravel() for g in grids], 1).astype("float32")
+    d = np.linalg.norm(coords[:, None, :] - pts[None], axis=2)
+    d.sort(axis=1)
+    bnd = np.exp(-(d[:, 1] - d[:, 0]) ** 2 / 4.0).reshape(shape)
+    return ndimage.gaussian_filter(bnd, 1.0).astype("float32")
+
+
+def test_pipelined_drain_bit_identical(tmp_path, tmp_workdir):
+    """writer_threads=4 / stream_window=3 (pipelined) vs writer_threads=0 /
+    stream_window=1 (fully sequential): same fragments, same maxId, same
+    staged per-block edge tables — the offset chain advances on the main
+    thread in both modes, so the pooled host tails must not change a bit."""
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.workflows.fused_pipeline import (
+        FusedSegmentationBlocks, _staged_path, clear_caches)
+
+    _, config_dir = tmp_workdir
+    shape = (34, 52, 48)  # not block-divisible: clipped border blocks
+    bnd = _instance(shape)
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        ds = f.require_dataset("bmap", shape=shape, chunks=(16, 24, 24),
+                               dtype="uint8")
+        ds[:] = np.round(bnd * 255).astype("uint8")
+
+    ConfigDir(config_dir).write_global_config({"block_shape": [16, 24, 24]})
+    modes = {
+        "seq": {"writer_threads": 0, "stream_window": 1},
+        "pipe": {"writer_threads": 4, "stream_window": 3},
+    }
+    staged = {}
+    for mode, knobs in modes.items():
+        ConfigDir(config_dir).write_task_config(
+            "fused_segmentation",
+            {"threshold": 0.4, "size_filter": 25, **knobs})
+        tmp_folder = str(tmp_path / f"tmp_{mode}")
+        task = FusedSegmentationBlocks(
+            input_path=path, input_key="bmap", output_path=path,
+            output_key=f"ws_{mode}", problem_path=str(tmp_path / "p.n5"),
+            tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=1,
+            target="tpu")
+        assert build([task], raise_on_failure=True)
+        blocks = {}
+        bid = 0
+        while os.path.exists(_staged_path(tmp_folder, bid)):
+            with np.load(_staged_path(tmp_folder, bid)) as d:
+                blocks[bid] = {k: d[k].copy() for k in d.files}
+            bid += 1
+        assert bid > 4  # genuinely multi-block
+        staged[mode] = blocks
+        clear_caches()  # the next run must not read this run's staging
+
+    with file_reader(path, "r") as f:
+        ws_seq = f["ws_seq"][:]
+        ws_pipe = f["ws_pipe"][:]
+        assert f["ws_seq"].attrs["maxId"] == f["ws_pipe"].attrs["maxId"]
+    np.testing.assert_array_equal(ws_seq, ws_pipe)
+    assert staged["seq"].keys() == staged["pipe"].keys()
+    for bid in staged["seq"]:
+        for key in ("uv", "feats", "k", "offset"):
+            np.testing.assert_array_equal(staged["seq"][bid][key],
+                                          staged["pipe"][bid][key])
+
+
+def test_fused_write_matches_apply_assignment_table(tmp_path, tmp_workdir):
+    """WriteAssignments' cache-fed LUT-gather fast path and the store-read
+    path must both reproduce apply_assignment_table exactly."""
+    from cluster_tools_tpu.core.blocking import Blocking
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.workflows import fused_pipeline as fp
+    from cluster_tools_tpu.workflows.write import (WriteAssignments,
+                                                   apply_assignment_table)
+
+    _, config_dir = tmp_workdir
+    shape = (20, 20, 20)
+    block_shape = [10, 10, 10]
+    blocking = Blocking(shape, block_shape)
+    rng = np.random.RandomState(0)
+
+    # globally-consecutive fragments assembled from per-block dense labels
+    # (exactly what the fused drain stages)
+    frags = np.zeros(shape, "uint64")
+    path = str(tmp_path / "d.n5")
+    off = 0
+    cache_entries = {}
+    for bid in range(blocking.n_blocks):
+        bb = blocking.get_block(bid).bb
+        k = int(rng.randint(3, 9))
+        local = rng.randint(0, k + 1,
+                            size=[s.stop - s.start for s in bb]).astype(
+                                "uint16")
+        out = local.astype("uint64")
+        out[out > 0] += np.uint64(off)
+        frags[bb] = out
+        cache_entries[bid] = (local, off, bb)
+        off += k
+    with file_reader(path) as f:
+        ds = f.require_dataset("ws", shape=shape, chunks=block_shape,
+                               dtype="uint64")
+        ds[:] = frags
+        ds.attrs["maxId"] = int(off)
+
+    # dense assignment table over [0, max_id]; background stays 0
+    table = np.concatenate([[0], rng.randint(
+        1, 7, size=off).astype("uint64")])
+    assignment_path = str(tmp_path / "assignments.npy")
+    np.save(assignment_path, table)
+    expected = apply_assignment_table(frags, table)
+
+    for mode, seed_cache in (("cached", True), ("store", False)):
+        fp.clear_caches()
+        if seed_cache:
+            key = (os.path.abspath(path), "ws")
+            for bid, ent in cache_entries.items():
+                fp._FRAGMENT_CACHE[key + (bid,)] = ent
+        task = WriteAssignments(
+            input_path=path, input_key="ws", output_path=path,
+            output_key=f"seg_{mode}", assignment_path=assignment_path,
+            identifier=f"fusedwrite_{mode}",
+            tmp_folder=str(tmp_path / f"tmp_{mode}"), config_dir=config_dir,
+            max_jobs=1, target="tpu")
+        assert build([task], raise_on_failure=True)
+        with file_reader(path, "r") as f:
+            got = f[f"seg_{mode}"][:]
+        np.testing.assert_array_equal(got, expected, err_msg=mode)
+
+
+def test_write_in_place_stays_sequential(tmp_path, tmp_workdir):
+    """In-place writes must not overlap read/write (torn-chunk hazard,
+    ADVICE r5) — and must still produce the correct result."""
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.workflows.write import (WriteAssignments,
+                                                   apply_assignment_table)
+
+    _, config_dir = tmp_workdir
+    shape = (20, 20, 20)
+    rng = np.random.RandomState(1)
+    frags = rng.randint(0, 9, size=shape).astype("uint64")
+    path = str(tmp_path / "d.n5")
+    with file_reader(path) as f:
+        # chunks deliberately NOT aligned to the 10^3 block grid
+        ds = f.require_dataset("seg", shape=shape, chunks=[8, 8, 8],
+                               dtype="uint64")
+        ds[:] = frags
+    table = np.concatenate([[0], rng.randint(1, 5, size=8)]).astype("uint64")
+    assignment_path = str(tmp_path / "assignments.npy")
+    np.save(assignment_path, table)
+    task = WriteAssignments(
+        input_path=path, input_key="seg", output_path=path,
+        output_key="seg", assignment_path=assignment_path,
+        identifier="inplace", tmp_folder=str(tmp_path / "tmp_ip"),
+        config_dir=config_dir, max_jobs=1, target="tpu")
+    assert build([task], raise_on_failure=True)
+    with file_reader(path, "r") as f:
+        got = f["seg"][:]
+    np.testing.assert_array_equal(got, apply_assignment_table(frags, table))
+
+
+def test_compact_seeds_int32_large_ids():
+    """Global uint64 seed ids past 2^31 (the r5 int32-downcast corruption
+    regime) compact to block-local int32 ids preserving zeros and the
+    full equality pattern."""
+    from cluster_tools_tpu.ops.mws import compact_seeds_int32
+
+    big = np.uint64(1) << np.uint64(33)
+    seeds = np.array([[0, big, big + np.uint64(1)],
+                      [big, 0, big + np.uint64(2 ** 31 + 7)],
+                      [big + np.uint64(1), big + np.uint64(1), 0]],
+                     dtype="uint64")
+    c = compact_seeds_int32(seeds)
+    assert c.dtype == np.int32 and c.shape == seeds.shape
+    np.testing.assert_array_equal(c == 0, seeds == 0)
+    flat_s, flat_c = seeds.ravel(), c.ravel()
+    same_s = flat_s[:, None] == flat_s[None, :]
+    same_c = flat_c[:, None] == flat_c[None, :]
+    np.testing.assert_array_equal(same_s, same_c)
+    # a plain downcast WOULD have collided/wrapped these ids
+    assert len(np.unique(flat_s.astype("int32"))) < len(np.unique(flat_s)) \
+        or (flat_s.astype("int32") <= 0).any()
+
+    # no-zero input keeps every id nonzero
+    c2 = compact_seeds_int32(np.array([big, big + np.uint64(5)]))
+    assert (c2 > 0).all() and c2[0] != c2[1]
+
+
+def test_sorted_edges_seeded_compaction_equivalence():
+    """The seeded device sort fed huge uint64 global seeds produces the
+    same sorted edge stream as the same seed PATTERN with small ids."""
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.ops.mws import _sorted_edges_resident
+
+    rng = np.random.RandomState(0)
+    shape = (4, 6, 6)
+    offsets = ((-1, 0, 0), (0, -1, 0), (0, 0, -1), (0, -3, 0))
+    affs = rng.rand(len(offsets), *shape).astype("float32")
+    affs_dev = jnp.asarray(affs)
+
+    pattern = rng.randint(0, 3, size=shape)  # 0 = unseeded
+    base = np.uint64(1) << np.uint64(33)
+    seeds_small = pattern.astype("uint64")
+    seeds_small[pattern > 0] += np.uint64(10)
+    seeds_huge = pattern.astype("uint64")
+    seeds_huge[pattern > 0] += base
+
+    streams = []
+    for seeds in (seeds_small, seeds_huge):
+        u, vp, asum = _sorted_edges_resident(
+            affs_dev, (0, 0, 0), shape, offsets, (1, 1, 1), seeds=seeds)
+        streams.append((np.asarray(u), np.asarray(vp)))
+    np.testing.assert_array_equal(streams[0][0], streams[1][0])
+    np.testing.assert_array_equal(streams[0][1], streams[1][1])
+
+
+def test_sorted_edges_resident_pack_guard():
+    """Outer blocks at/past 2^29 voxels must be rejected before they can
+    corrupt the 29-bit packed partner index."""
+    from cluster_tools_tpu.ops.mws import _sorted_edges_resident
+
+    with pytest.raises(ValueError, match="2\\^29"):
+        _sorted_edges_resident(None, (0, 0, 0), (1024, 1024, 512),
+                               ((-1, 0, 0),), (1, 1, 1))
+
+
+def test_normalize_global_max_parity():
+    """Blockwise normalization with the pinned global max matches the
+    whole-volume normalization the device-resident path performs."""
+    from cluster_tools_tpu.workflows.mutex_watershed import normalize
+
+    rng = np.random.RandomState(0)
+    vol = (rng.rand(3, 8, 8, 8) * 3.7).astype("float32")
+    full = normalize(vol)
+    mx = float(vol.max())
+    for sl in (np.s_[:, :4], np.s_[:, 4:], np.s_[:, 2:6]):
+        np.testing.assert_allclose(normalize(vol[sl], mx=mx), full[sl],
+                                   rtol=1e-6)
