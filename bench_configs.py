@@ -35,6 +35,11 @@ OFFSETS = [[-1, 0, 0], [0, -1, 0], [0, 0, -1],
            [-4, 0, 0], [0, -4, 0], [0, 0, -4]]
 
 
+# env-overridable geometry for smoke runs on small hosts; every config
+# records the shape it actually measured in its JSON
+from bench import _env_shape  # noqa: E402  (same directory)
+
+
 def _blob_volume(shape, seed=0):
     """Smoothed random field normalized to [0,1]: thresholding yields
     many multi-block blobs (O(volume) generation — per-blob meshgrids
@@ -108,10 +113,95 @@ def _workdir(name, target):
 
 
 # ---------------------------------------------------------------------------
+# config 1: single-block distance-transform watershed (BASELINE.json
+# config 1: "DT watershed on CREMI sample A boundary map, single block")
+# ---------------------------------------------------------------------------
+
+WS_SHAPE = _env_shape("BENCH_CFG_WS_SHAPE", (50, 512, 512))
+
+
+def run_ws_chain(store, target="tpu"):
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+    workdir = _workdir("ws", target)
+    cfg = ConfigDir(os.path.join(workdir, "configs"))
+    # ONE block covering the volume: the single-block regime of config 1
+    cfg.write_global_config({"block_shape": list(WS_SHAPE)})
+    if target == "local":
+        cfg.write_task_config("watershed", {"threshold": 0.4,
+                                            "size_filter": 50,
+                                            "impl": "host"})
+    else:
+        cfg.write_task_config("watershed", {"threshold": 0.4,
+                                            "size_filter": 50})
+    t0 = time.perf_counter()
+    wf = WatershedWorkflow(
+        input_path=store, input_key="bmap", output_path=store,
+        output_key=f"ws_{target}", tmp_folder=workdir,
+        config_dir=os.path.join(workdir, "configs"), max_jobs=1,
+        target=target)
+    assert ctt.build([wf], raise_on_failure=True)
+    elapsed = time.perf_counter() - t0
+    with file_reader(store, "r") as f:
+        seg = f[f"ws_{target}"][:]
+    return elapsed, seg
+
+
+def config1():
+    from scipy.spatial import cKDTree
+
+    from cluster_tools_tpu.core.storage import file_reader
+
+    rng = np.random.RandomState(0)
+    n_cells = max(int(np.prod(WS_SHAPE) / 70000), 8)
+    pts = (rng.rand(n_cells, 3) * np.array(WS_SHAPE)).astype("float32")
+    tree = cKDTree(pts)
+    grids = np.meshgrid(*[np.arange(s, dtype="float32")
+                          for s in WS_SHAPE], indexing="ij")
+    d, _ = tree.query(np.stack([g.ravel() for g in grids], 1), k=2)
+    bnd = np.exp(-0.5 * ((d[:, 1] - d[:, 0]) / 2.0) ** 2
+                 ).reshape(WS_SHAPE).astype("float32")
+    store = "/tmp/ctt_bench_cfg/ws.n5"
+    shutil.rmtree(store, ignore_errors=True)
+    with file_reader(store) as f:
+        f.require_dataset("bmap", shape=WS_SHAPE, chunks=list(WS_SHAPE),
+                          dtype="uint8")[:] = np.round(
+                              bnd * 255).astype("uint8")
+
+    run_ws_chain(store, "tpu")  # warm compiles
+    dev_t, dev_seg = run_ws_chain(store, "tpu")
+    cpu_t, cpu_seg = _run_local_subprocess(
+        "run_ws_chain", (store,), "/tmp/ctt_bench_cfg/ws_local")
+
+    # watershed fragments OVER-segment by design: quality here is that
+    # both paths produce a dense fragment cover of comparable granularity
+    # (VOI parity of the final segmentation is config 4's gate)
+    n_dev = len(np.unique(dev_seg[dev_seg > 0]))
+    n_cpu = len(np.unique(cpu_seg[cpu_seg > 0]))
+    assert n_dev > n_cells / 2 and n_cpu > n_cells / 2, (n_dev, n_cpu)
+    n = int(np.prod(WS_SHAPE))
+    return {
+        "config": 1,
+        "workflow": "WatershedWorkflow (single-block DT watershed)",
+        "volume_mvox": round(n / 1e6, 1), "shape": list(WS_SHAPE),
+        "block_shape": list(WS_SHAPE),
+        "device_vox_per_sec": round(n / dev_t, 1),
+        "cpu_vox_per_sec": round(n / cpu_t, 1),
+        "vs_baseline": round(cpu_t / dev_t, 3),
+        "n_fragments": {"device": n_dev, "cpu": n_cpu},
+        "quality": "dense fragment cover, comparable granularity "
+                   "(VOI parity gated in config 4)",
+    }
+
+
+# ---------------------------------------------------------------------------
 # config 2: connected components + stitching
 # ---------------------------------------------------------------------------
 
-CC_SHAPE = (64, 512, 512)
+CC_SHAPE = _env_shape("BENCH_CFG_CC_SHAPE", (64, 512, 512))
 CC_BLOCK = [32, 256, 256]
 #: ~500 components spanning blocks at this threshold of the smoothed field
 CC_THRESHOLD = 0.6
@@ -169,7 +259,8 @@ def config2():
     return {
         "config": 2,
         "workflow": "ThresholdedComponentsWorkflow (CC + stitching)",
-        "volume_mvox": round(n / 1e6, 1), "block_shape": CC_BLOCK,
+        "volume_mvox": round(n / 1e6, 1), "shape": list(CC_SHAPE),
+        "block_shape": CC_BLOCK,
         "device_vox_per_sec": round(n / dev_t, 1),
         "cpu_vox_per_sec": round(n / cpu_t, 1),
         "vs_baseline": round(cpu_t / dev_t, 3),
@@ -181,7 +272,7 @@ def config2():
 # config 3: mutex watershed on long-range affinities
 # ---------------------------------------------------------------------------
 
-MWS_SHAPE = (64, 512, 512)
+MWS_SHAPE = _env_shape("BENCH_CFG_MWS_SHAPE", (64, 512, 512))
 MWS_BLOCK = [32, 256, 256]
 
 
@@ -243,7 +334,8 @@ def config3():
         "config": 3,
         "workflow": "TwoPassMwsWorkflow (checkerboard mutex watershed, "
                     f"{len(OFFSETS)} offsets)",
-        "volume_mvox": round(n / 1e6, 1), "block_shape": MWS_BLOCK,
+        "volume_mvox": round(n / 1e6, 1), "shape": list(MWS_SHAPE),
+        "block_shape": MWS_BLOCK,
         "device_vox_per_sec": round(n / dev_t, 1),
         "cpu_vox_per_sec": round(n / cpu_t, 1),
         "vs_baseline": round(cpu_t / dev_t, 3),
@@ -255,7 +347,7 @@ def config3():
 # config 5: U-Net affinity inference + mutex watershed
 # ---------------------------------------------------------------------------
 
-INF_SHAPE = (32, 256, 256)
+INF_SHAPE = _env_shape("BENCH_CFG_INF_SHAPE", (32, 256, 256))
 INF_BLOCK = [16, 128, 128]
 
 
@@ -326,7 +418,8 @@ def config5():
         "config": 5,
         "workflow": "InferenceTask (3D U-Net affinities, uint8 requant) "
                     "+ MwsWorkflow",
-        "volume_mvox": round(n / 1e6, 1), "block_shape": INF_BLOCK,
+        "volume_mvox": round(n / 1e6, 1), "shape": list(INF_SHAPE),
+        "block_shape": INF_BLOCK,
         "device_vox_per_sec": round(n / dev_t, 1),
         "cpu_vox_per_sec": round(n / cpu_t, 1),
         "vs_baseline": round(cpu_t / dev_t, 3),
@@ -338,7 +431,11 @@ def config5():
 def main():
     sys.path.insert(0, ROOT)
     os.makedirs("/tmp/ctt_bench_cfg", exist_ok=True)
-    for name, fn in (("2", config2), ("3", config3), ("5", config5)):
+    only = set(sys.argv[1:])
+    todo = (("1", config1), ("2", config2), ("3", config3), ("5", config5))
+    for name, fn in todo:
+        if only and name not in only:
+            continue
         t0 = time.perf_counter()
         res = fn()
         res["bench_seconds"] = round(time.perf_counter() - t0, 1)

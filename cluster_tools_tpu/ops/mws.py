@@ -98,9 +98,15 @@ def _grid_edges_device(affs: jnp.ndarray, mask: jnp.ndarray, key: jnp.ndarray,
 def grid_graph_edges_host(affs: np.ndarray,
                           offsets: Sequence[Sequence[int]],
                           strides: Optional[Sequence[int]] = None,
-                          mask: Optional[np.ndarray] = None):
+                          mask: Optional[np.ndarray] = None,
+                          id_offset: int = 0):
     """Host (numpy) edge extraction — same semantics as the device path
     for the deterministic cases (no noise, no randomized strides).
+
+    ``id_offset`` shifts the flat voxel ids into a global frame (a
+    shard-local origin's flat offset): sharded/mesh callers extract each
+    shard's grid edges in its own window and concatenate without id
+    collisions.
 
     The clustering consumer needs the FULL edge list in host memory, and
     the indices are pure arange arithmetic over data the host already
@@ -113,7 +119,8 @@ def grid_graph_edges_host(affs: np.ndarray,
     strides = tuple(int(s) for s in (strides or (1,) * ndim))
     if mask is not None:
         mask = np.asarray(mask).astype(bool)
-    flat = np.arange(int(np.prod(shape)), dtype=np.int64).reshape(shape)
+    flat = np.arange(int(np.prod(shape)), dtype=np.int64).reshape(shape) \
+        + np.int64(id_offset)
     uva, wa, uvm, wm = [], [], [], []
     for c, off in enumerate(offsets):
         sl_a, sl_b = _offset_slices(off, shape)
@@ -155,18 +162,20 @@ def grid_graph_edges(affs: np.ndarray, offsets: Sequence[Sequence[int]],
                      randomize_strides: bool = False,
                      mask: Optional[np.ndarray] = None,
                      noise_level: float = 0.0, seed: int = 0,
-                     impl: str = "auto"):
+                     impl: str = "auto", id_offset: int = 0):
     """Extract (uv_attractive, w_attractive, uv_mutex, w_mutex) host arrays.
 
     ``impl='auto'`` uses the host path for the deterministic cases (see
     grid_graph_edges_host) and the device program when noise injection or
-    randomized strides need the jax PRNG stream."""
+    randomized strides need the jax PRNG stream.  ``id_offset`` shifts
+    voxel ids into a global frame (shard-local origins, see
+    grid_graph_edges_host)."""
     if impl == "auto":
         impl = ("device" if (noise_level > 0 or randomize_strides)
                 else "host")
     if impl == "host":
         return grid_graph_edges_host(affs, offsets, strides=strides,
-                                     mask=mask)
+                                     mask=mask, id_offset=id_offset)
     ndim = len(offsets[0])
     shape = affs.shape[1:]
     assert affs.shape[0] == len(offsets), (affs.shape, len(offsets))
@@ -183,8 +192,10 @@ def grid_graph_edges(affs: np.ndarray, offsets: Sequence[Sequence[int]],
     # np.asarray is its own round trip on tunnel-attached chips, and the
     # per-channel fetches made small-block extraction latency-bound
     lengths = [int(u.shape[0]) for u, _, _, _ in per_channel]
-    u_all = np.asarray(jnp.concatenate([u for u, _, _, _ in per_channel]))
-    v_all = np.asarray(jnp.concatenate([v for _, v, _, _ in per_channel]))
+    u_all = np.asarray(jnp.concatenate(
+        [u for u, _, _, _ in per_channel])).astype("int64") + id_offset
+    v_all = np.asarray(jnp.concatenate(
+        [v for _, v, _, _ in per_channel])).astype("int64") + id_offset
     w_all = np.asarray(jnp.concatenate([w for _, _, w, _ in per_channel]))
     ok_all = np.asarray(jnp.concatenate(
         [ok for _, _, _, ok in per_channel]))

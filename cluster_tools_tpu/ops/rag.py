@@ -311,6 +311,32 @@ def boundary_pair_values_dual(labels: jnp.ndarray, bmap: jnp.ndarray,
             jnp.concatenate(va), jnp.concatenate(vb), jnp.concatenate(ok))
 
 
+def plane_face_pairs(lab_a: jnp.ndarray, lab_b: jnp.ndarray,
+                     valid: Optional[jnp.ndarray] = None,
+                     ignore_label: bool = True):
+    """Face pairs between two OPPOSING boundary planes of adjacent
+    subproblems (blocks or mesh shards): ``lab_a[i]`` and ``lab_b[i]``
+    are the labels of the two voxels straddling the face.  This is the
+    device-side form of the host face scan in FusedFaceAssembly — the
+    mesh-resident program feeds it the ``ppermute``-received neighbor
+    plane, so cross-shard edges join the same collective edge-feature
+    reduction as interior pairs instead of a host stitching pass.
+
+    Returns flat ``(u, v, ok)`` with u < v for valid entries (the pair
+    (i, i+1) belongs to the subproblem owning voxel i — the reference's
+    ownership rule; the caller masks out subproblems without a real
+    upper neighbor via ``valid``)."""
+    ok = lab_a != lab_b
+    if ignore_label:
+        ok &= (lab_a != 0) & (lab_b != 0)
+    if valid is not None:
+        ok &= valid
+    u = jnp.minimum(lab_a, lab_b).reshape(-1)
+    v = jnp.maximum(lab_a, lab_b).reshape(-1)
+    m = ok.reshape(-1)
+    return jnp.where(m, u, 0), jnp.where(m, v, 0), m
+
+
 def _hist_finish(hist, u_o, v_o, run_id, valid, n_runs, e_max: int):
     """Shared tail of the histogram edge statistics: exact
     mean/var/min/max and position-interpolated quantiles from per-edge

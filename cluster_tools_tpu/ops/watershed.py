@@ -32,6 +32,49 @@ def _flat_offsets(shape: Tuple[int, ...], connectivity: int) -> Tuple[Tuple[int,
     return _neighbor_offsets(len(shape), connectivity)
 
 
+def extent_valid_mask(local_shape, extent=None, origin=None, vol_shape=None):
+    """Jit-composable validity mask over a block/shard-local window.
+
+    Two conventions, one mask: pass ``extent`` (per-axis REAL size of a
+    clipped border block — the blockwise resident program's convention),
+    or a SHARD-LOCAL ``origin`` (traced per-shard int vector, e.g.
+    ``axis_index * slab_z``) plus the static global ``vol_shape`` — the
+    mesh-resident convention, where a shard's local window may overrun the
+    volume end by the shard-equalizing pad.  Positions at or past the
+    volume end are invalid (their reflect/zero-padded content must never
+    enter label ranks, id counts or pair sets)."""
+    if extent is None:
+        if origin is None or vol_shape is None:
+            raise ValueError("pass extent, or origin + vol_shape")
+        extent = [jnp.asarray(vol_shape[d], jnp.int32) - origin[d]
+                  for d in range(len(local_shape))]
+    valid = jnp.ones(tuple(local_shape), bool)
+    for d, n in enumerate(local_shape):
+        coord = jnp.arange(n)
+        shape_d = [1] * len(local_shape)
+        shape_d[d] = n
+        valid &= (coord < extent[d]).reshape(shape_d)
+    return valid
+
+
+def dense_relabel(inner, n_bound: int, valid=None):
+    """Dense per-window relabel (device-side np.unique/searchsorted:
+    presence flags + cumsum rank) of the nonzero labels in ``inner``,
+    whose values are bounded by ``n_bound``.  ``valid`` masks voxels out
+    of the relabel entirely (phantom padding).  Returns
+    ``(dense_grid int32, k)`` with dense ids consecutive in [1, k] — the
+    shared tail of every resident segmentation program (blockwise and
+    mesh-resident alike), so the id convention lives in one place."""
+    if valid is not None:
+        inner = jnp.where(valid, inner, 0)
+    flat = inner.reshape(-1)
+    pres = jnp.zeros((n_bound + 2,), jnp.int32).at[flat].set(1, mode="drop")
+    pres = pres.at[0].set(0)
+    rank = jnp.cumsum(pres)
+    dense = jnp.where(flat > 0, rank[flat], 0).astype(jnp.int32)
+    return dense.reshape(inner.shape), rank[-1]
+
+
 def seeded_watershed(
     height: jnp.ndarray,
     seeds: jnp.ndarray,

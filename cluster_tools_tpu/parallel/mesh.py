@@ -110,7 +110,9 @@ def blocks_mesh(n_devices: Optional[int] = None) -> Mesh:
 def single_axis_mesh(axis: str, n_shards: int,
                      n_devices: Optional[int] = None) -> Mesh:
     """Mesh with one named axis spanning the first ``n_shards`` devices
-    (shared constructor for the expert/seq single-axis meshes).  A mesh over
+    (shared constructor for the expert/seq single-axis meshes and the
+    mesh-resident flagship's ``shard`` axis — one z-slab subproblem per
+    device, workflows/fused_pipeline._mesh_resident_program).  A mesh over
     a device subset (``n_shards < n_devices``) is allowed."""
     devices = jax.devices()
     n = n_devices or len(devices)

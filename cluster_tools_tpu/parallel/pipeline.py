@@ -80,13 +80,10 @@ def pipeline_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
 
         # initial carries must already be marked device-varying over the
         # pipe axis (the loop body makes them varying via ppermute/where)
-        def _varying(a):
-            if hasattr(jax.lax, "pcast"):
-                return jax.lax.pcast(a, (axis,), to="varying")
-            return jax.lax.pvary(a, (axis,))
+        from .stencil import device_varying
 
-        act0 = _varying(jnp.zeros_like(xp[0]))
-        out0 = _varying(jnp.zeros_like(xp))
+        act0 = device_varying(jnp.zeros_like(xp[0]), axis)
+        out0 = device_varying(jnp.zeros_like(xp), axis)
         _, out = jax.lax.fori_loop(0, n_steps, step, (act0, out0))
         # only the last stage wrote non-zeros; broadcast via psum
         return jax.lax.psum(out, axis)

@@ -86,15 +86,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
         # initial accumulators must be marked device-varying over the ring
         # axis (the loop makes them varying via the per-shard partials)
-        def _varying(a):
-            if hasattr(jax.lax, "pcast"):
-                return jax.lax.pcast(a, (axis,), to="varying")
-            return jax.lax.pvary(a, (axis,))
+        from .stencil import device_varying
 
         h, d = ql.shape[1], ql.shape[2]
-        m0 = _varying(jnp.full((h, t_local), -jnp.inf, jnp.float32))
-        l0 = _varying(jnp.zeros((h, t_local), jnp.float32))
-        o0 = _varying(jnp.zeros((h, t_local, d), jnp.float32))
+        m0 = device_varying(jnp.full((h, t_local), -jnp.inf, jnp.float32),
+                            axis)
+        l0 = device_varying(jnp.zeros((h, t_local), jnp.float32), axis)
+        o0 = device_varying(jnp.zeros((h, t_local, d), jnp.float32), axis)
         carry = (kl, vl, m0, l0, o0)
         # the final hop attends without rotating (its permuted chunk would
         # be discarded — one full K+V ICI transfer saved per call)

@@ -30,6 +30,31 @@ def _take(x: jnp.ndarray, axis: int, sl: slice) -> jnp.ndarray:
     return x[tuple(idx)]
 
 
+def axis_size(mesh_axis: str) -> int:
+    """STATIC size of a named mesh axis inside shard_map (the ppermute
+    ring and the edge-shard handling below need it as a Python int).
+    ``jax.lax.axis_size`` only exists in newer jax; fall back to the
+    tracing axis env."""
+    try:
+        return int(jax.lax.axis_size(mesh_axis))
+    except AttributeError:
+        from jax._src import core as _core
+
+        return int(_core.get_axis_env().axis_size(mesh_axis))
+
+
+def device_varying(a: jnp.ndarray, mesh_axis: str) -> jnp.ndarray:
+    """Mark ``a`` device-varying over ``mesh_axis`` inside shard_map —
+    the jax-version shim (pcast on current jax, pvary on the vma
+    transition releases, no-op on pre-vma jax where unmarked values are
+    already varying) shared by the ring collectives."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(a, (mesh_axis,), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(a, (mesh_axis,))
+    return a
+
+
 def halo_exchange(x: jnp.ndarray, halo: int, axis: int, mesh_axis: str,
                   fill: Any = 0, mode: str = "constant") -> jnp.ndarray:
     """Grow ``x`` by ``halo`` on both ends of ``axis`` with neighbor data.
@@ -37,10 +62,12 @@ def halo_exchange(x: jnp.ndarray, halo: int, axis: int, mesh_axis: str,
     Must be called inside shard_map with ``mesh_axis`` a named mesh axis.
     ``mode``: 'constant' (pad with fill) or 'reflect' at the outer volume
     borders (reference: inference reflect-padding, inference.py:202-232).
+    'reflect' mirrors excluding the border plane (numpy/jnp.pad 'reflect'
+    semantics) and therefore needs ``halo <= x.shape[axis] - 1``.
     """
     if halo <= 0:
         return x
-    n = jax.lax.axis_size(mesh_axis)
+    n = axis_size(mesh_axis)
     idx = jax.lax.axis_index(mesh_axis)
 
     lo_slab = _take(x, axis, slice(0, halo))           # my low boundary
@@ -58,8 +85,16 @@ def halo_exchange(x: jnp.ndarray, halo: int, axis: int, mesh_axis: str,
         recv_hi = hi_slab
 
     if mode == "reflect":
-        pad_lo = jnp.flip(lo_slab, axis=axis)
-        pad_hi = jnp.flip(hi_slab, axis=axis)
+        # numpy-style reflect: mirror EXCLUDING the border plane, the
+        # same fold as jnp.pad(mode='reflect') and the blockwise chain's
+        # volume-level reflect_indices (period 2n-2) — including it
+        # would duplicate the border plane and silently diverge from
+        # the per-block readers.  Requires halo <= size-1 on this axis
+        # (same constraint jnp.pad imposes; callers clamp)
+        size = x.shape[axis]
+        pad_lo = jnp.flip(_take(x, axis, slice(1, halo + 1)), axis=axis)
+        pad_hi = jnp.flip(_take(x, axis, slice(size - halo - 1, size - 1)),
+                          axis=axis)
     else:
         pad_lo = jnp.full_like(lo_slab, fill)
         pad_hi = jnp.full_like(hi_slab, fill)

@@ -197,6 +197,14 @@ class MergeSubGraphs(BlockTask):
                      if any(len(n) for n in node_lists) else np.zeros(0, "uint64"))
             g.save_graph(graph_path, cfg["output_key"], nodes, edges, shape,
                          ignore_label=bool(cfg.get("ignore_label", True)))
+            # record the decomposition the sub-graphs were built on: the
+            # problem container is self-describing, so the solver stack
+            # (SolveSubproblems/ReduceProblem) iterates the SAME grid even
+            # when it differs from the global block shape (mesh-resident
+            # slabs)
+            with file_reader(graph_path) as f:
+                f[cfg["output_key"]].attrs["sub_graph_block_shape"] = \
+                    list(base_bs)
             log_fn(f"global graph: {len(nodes)} nodes, {len(edges)} edges")
             return
 

@@ -52,6 +52,18 @@ def _load_scale_graph(problem_path: str, scale: int):
     return edges.astype("int64"), n_nodes, None
 
 
+def _problem_geometry(problem_path: str, fallback_bs):
+    """(shape, base block shape) of the serialized problem: the s0 graph
+    records the decomposition its sub-graphs were built on
+    (``sub_graph_block_shape``, e.g. mesh-resident slabs); older
+    containers fall back to the caller's global block shape."""
+    with file_reader(problem_path, "r") as f:
+        attrs = f["s0/graph"].attrs
+        shape = list(attrs["shape"])
+        base_bs = list(attrs.get("sub_graph_block_shape") or fallback_bs)
+    return shape, base_bs
+
+
 def _sub_result_path(problem_path: str, scale: int, block_id: int) -> str:
     return os.path.join(problem_path, f"s{scale}", "sub_results",
                         f"block_{block_id}.npz")
@@ -103,9 +115,8 @@ class SolveSubproblems(BlockTask):
         return {}
 
     def run_impl(self):
-        with file_reader(self.problem_path, "r") as f:
-            shape = list(f[f"s0/graph"].attrs["shape"])
-        base_bs = self.global_block_shape()
+        shape, base_bs = _problem_geometry(self.problem_path,
+                                           self.global_block_shape())
         scale_bs = [b * 2 ** self.scale for b in base_bs]
         block_list = self.blocks_in_volume(shape, scale_bs)
         self.run_jobs(block_list, {
@@ -191,9 +202,8 @@ class ReduceProblem(BlockTask):
         super().__init__(**kw)
 
     def run_impl(self):
-        with file_reader(self.problem_path, "r") as f:
-            shape = list(f["s0/graph"].attrs["shape"])
-        base_bs = self.global_block_shape()
+        shape, base_bs = _problem_geometry(self.problem_path,
+                                           self.global_block_shape())
         scale_bs = [b * 2 ** self.scale for b in base_bs]
         self.run_jobs(None, {
             "problem_path": self.problem_path, "scale": self.scale,
@@ -391,7 +401,8 @@ class SubSolutions(BlockTask):
     def run_impl(self):
         with file_reader(self.ws_path, "r") as f:
             shape = list(f[self.ws_key].shape)
-        base_bs = self.global_block_shape()
+        _, base_bs = _problem_geometry(self.problem_path,
+                                       self.global_block_shape())
         scale_bs = [b * 2 ** self.scale for b in base_bs]
         with file_reader(self.output_path) as f:
             f.require_dataset(self.output_key, shape=shape,

@@ -11,7 +11,10 @@ import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: tests never touch accelerators
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+# CTT_NO_VIRTUAL_MESH=1 opts out of the virtual mesh (e.g. to mimic a true
+# single-device host); tests marked ``mesh`` then self-skip
+if "xla_force_host_platform_device_count" not in _flags \
+        and os.environ.get("CTT_NO_VIRTUAL_MESH") != "1":
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
@@ -39,6 +42,21 @@ except Exception:
     pass
 
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Tests marked ``mesh`` need the emulated multi-device mesh: they
+    self-skip when ``--xla_force_host_platform_device_count`` is not in
+    XLA_FLAGS (a true single-device host, or CTT_NO_VIRTUAL_MESH=1)."""
+    if "xla_force_host_platform_device_count" in os.environ.get(
+            "XLA_FLAGS", ""):
+        return
+    skip = pytest.mark.skip(
+        reason="needs --xla_force_host_platform_device_count (emulated "
+               "multi-device mesh)")
+    for item in items:
+        if "mesh" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture()

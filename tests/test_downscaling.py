@@ -3,6 +3,7 @@
 import os
 
 import numpy as np
+import pytest
 
 from cluster_tools_tpu.core.storage import file_reader
 from cluster_tools_tpu.core.workflow import build
@@ -362,6 +363,7 @@ def test_upscale_task(tmp_workdir, tmp_path):
     assert finef.max() <= vol.max() + 1e-5
 
 
+@pytest.mark.slow
 def test_scale_to_boundaries(tmp_workdir, tmp_path):
     from cluster_tools_tpu.workflows.downscaling import ScaleToBoundariesTask
 

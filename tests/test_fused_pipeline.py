@@ -2,6 +2,7 @@
 segmentation."""
 
 import numpy as np
+import pytest
 
 from cluster_tools_tpu.core.storage import file_reader
 from cluster_tools_tpu.core.workflow import build
@@ -27,6 +28,7 @@ def _partition_bijection(a, b):
             and len(np.unique(pairs[:, 1])) == len(pairs))
 
 
+@pytest.mark.slow
 def test_fused_matches_classic_chain(tmp_path, tmp_workdir):
     import cluster_tools_tpu as ctt
     from cluster_tools_tpu.core.config import ConfigDir

@@ -51,6 +51,7 @@ def _run_cc(path, tmp_folder, config_dir, target, out_key):
         return f[out_key][:]
 
 
+@pytest.mark.mesh
 def test_mesh_cc_bit_identical_to_local(cc_setup):
     vol, path, tmp_folder, config_dir = cc_setup
     local = _run_cc(path, tmp_folder, config_dir, "local", "cc_local")
@@ -60,6 +61,7 @@ def test_mesh_cc_bit_identical_to_local(cc_setup):
     assert len(np.unique(local)) > 5
 
 
+@pytest.mark.mesh
 def test_mesh_cc_covers_device_faces(cc_setup, tmp_path):
     """The mesh phase must put a nonzero number of face merges on the
     device path (ppermute over the mesh axis), not fall back to host for
@@ -77,6 +79,8 @@ def test_mesh_cc_covers_device_faces(cc_setup, tmp_path):
     assert meta["n_labels"] > 5
 
 
+@pytest.mark.mesh
+@pytest.mark.slow
 def test_mesh_watershed_matches_inline(tmp_path, tmp_workdir):
     from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
 
@@ -106,6 +110,8 @@ def test_mesh_watershed_matches_inline(tmp_path, tmp_workdir):
     assert (segs["inline"] > 0).all()
 
 
+@pytest.mark.mesh
+@pytest.mark.slow
 def test_fused_flagship_mesh_matches_tpu(tmp_path, tmp_workdir):
     """The FLAGSHIP fused chain under target='mesh' (SPMD rounds, one
     block per device) produces the identical problem and segmentation as

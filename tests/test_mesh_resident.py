@@ -1,0 +1,198 @@
+"""Mesh-resident SPMD flagship (workflows/fused_pipeline._process_mesh):
+one sharded program for the whole volume — halo exchange over the mesh,
+collective label offsets, on-device cross-shard faces — VOI-compatible
+with the blockwise path and dispatching exactly ONE compiled program."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.core.storage import file_reader
+
+
+def test_mesh_slab_block_shape():
+    from cluster_tools_tpu.workflows.fused_pipeline import \
+        mesh_slab_block_shape
+
+    assert mesh_slab_block_shape((48, 128, 128), 4) == [12, 128, 128]
+    assert mesh_slab_block_shape((50, 128, 128), 4) == [13, 128, 128]
+    assert mesh_slab_block_shape((3, 8, 8), 8) == [1, 8, 8]
+
+
+def test_mws_grid_edges_shard_local_origin():
+    """ops/mws grid-edge extraction accepts shard-local origins: with
+    ``id_offset`` the flat voxel ids shift into the global frame, so
+    sharded callers concatenate shard windows without id collisions."""
+    from cluster_tools_tpu.ops.mws import grid_graph_edges
+
+    rng = np.random.RandomState(0)
+    affs = rng.rand(3, 4, 5, 6).astype("float32")
+    offsets = [[-1, 0, 0], [0, -1, 0], [0, 0, -1]]
+    uva0, wa0, _, _ = grid_graph_edges(affs, offsets, impl="host")
+    uva1, wa1, _, _ = grid_graph_edges(affs, offsets, impl="host",
+                                       id_offset=1000)
+    np.testing.assert_array_equal(uva1, uva0 + 1000)
+    np.testing.assert_array_equal(wa1, wa0)
+    uva2, wa2, _, _ = grid_graph_edges(affs, offsets, impl="device",
+                                       id_offset=1000)
+    np.testing.assert_array_equal(np.sort(uva2, axis=0),
+                                  np.sort(uva1, axis=0))
+
+
+@pytest.mark.slow
+@pytest.mark.mesh
+def test_mesh_program_rag_matches_host():
+    """The sharded program's edge tables must union to EXACTLY the RAG of
+    the labeled volume it emits — interior pairs per shard plus every
+    cross-shard face pair once (the collective reduction replaces the
+    host face scan, so nothing may be dropped or doubled)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cluster_tools_tpu.ops.rag import host_label_pairs
+    from cluster_tools_tpu.workflows.fused_pipeline import \
+        _mesh_resident_program
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    rng = np.random.RandomState(0)
+    shape = (12, 16, 16)
+    from scipy import ndimage
+
+    vol = ndimage.gaussian_filter(rng.rand(*shape).astype("float32"), 2.0)
+    vol = (vol - vol.min()) / (vol.max() - vol.min())
+    vol_u8 = np.round(vol * 255).astype("uint8")
+
+    n_shards, slab_z = 2, 6
+    program, mesh = _mesh_resident_program(
+        n_shards, slab_z, shape, (2, 4, 4), "uint8",
+        0.5, 1.0, 1.0, 0.8, 5, 4096, 2, 1 << 14, 2)
+    vol_dev = jax.device_put(vol_u8,
+                             NamedSharding(mesh, P("shard", None, None)))
+    lab_d, meta_d, uv_d, feats_d = program(vol_dev)
+    meta = np.asarray(meta_d).astype("int64")
+    assert meta[:, 4].all(), "watershed capacity"
+    assert (meta[:, 2] == 0).all() and (meta[:, 3] == 0).all(), "overflow"
+    lab = np.asarray(lab_d)
+    ks = meta[:, 0]
+
+    # labels globally consecutive, shard id ranges disjoint
+    uniq = np.unique(lab)
+    uniq = uniq[uniq > 0]
+    np.testing.assert_array_equal(uniq, np.arange(1, ks.sum() + 1))
+    offs = np.concatenate([[0], np.cumsum(ks)])
+    for s in range(n_shards):
+        sl = lab[s * slab_z:(s + 1) * slab_z]
+        svals = np.unique(sl)
+        svals = svals[svals > 0]
+        assert (svals > offs[s]).all() and (svals <= offs[s + 1]).all()
+
+    # union of shard tables == host RAG of the emitted label volume
+    uv = np.asarray(uv_d).reshape(n_shards, -1, 2)
+    got = np.concatenate([uv[s, :meta[s, 1]] for s in range(n_shards)])
+    got = got[np.lexsort((got[:, 1], got[:, 0]))]
+    want = host_label_pairs(lab.astype("uint64"))
+    np.testing.assert_array_equal(got.astype("uint64"), want)
+    # sample counts: every adjacent differing pair contributes 2 samples
+    feats = np.asarray(feats_d).reshape(n_shards, -1, 10)
+    cnt = np.concatenate([feats[s, :meta[s, 1], -1]
+                          for s in range(n_shards)])
+    assert (cnt >= 2).all() and cnt.sum() % 2 == 0
+
+
+@pytest.mark.slow
+@pytest.mark.mesh
+def test_mesh_flagship_voi_parity(tmp_path):
+    """Acceptance: the mesh-resident flagship on an emulated >= 4-device
+    mesh is VOI-compatible (delta <= 0.01) with the blockwise path,
+    dispatches exactly one compiled program per volume (EXEC_CACHE_STATS)
+    with ONE steady-state sync-execute wait (vs one per block), and the
+    problem container records the slab decomposition."""
+    from scipy.spatial import cKDTree
+
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core import runtime as rt
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.utils.validation import (ContingencyTable,
+                                                    cremi_score_from_table)
+
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+
+    rng = np.random.RandomState(0)
+    shape = (48, 128, 128)
+    pts = (rng.rand(12, 3) * np.array(shape)).astype("float32")
+    tree = cKDTree(pts)
+    grids = np.meshgrid(*[np.arange(s, dtype="float32") for s in shape],
+                        indexing="ij")
+    d, idx = tree.query(np.stack([g.ravel() for g in grids], 1), k=2)
+    gt = (idx[:, 0] + 1).reshape(shape).astype("uint64")
+    bnd = np.exp(-0.5 * ((d[:, 1] - d[:, 0]) / 2.0) ** 2).reshape(shape)
+
+    path = str(tmp_path / "d.n5")
+    block = [16, 64, 64]
+    with file_reader(path) as f:
+        ds = f.require_dataset("bmap", shape=shape, chunks=tuple(block),
+                               dtype="uint8")
+        ds[:] = np.round(bnd * 255).astype("uint8")
+
+    def run(mode, tag):
+        config_dir = str(tmp_path / f"configs_{tag}")
+        cfg = ConfigDir(config_dir)
+        cfg.write_global_config({"block_shape": block,
+                                 "max_num_retries": 0})
+        cfg.write_task_config("fused_segmentation", {
+            "threshold": 0.4, "size_filter": 50, "halo": [2, 8, 8],
+            "mesh_resident": mode == "mesh", "mesh_shards": 4})
+        mc = ctt.MulticutSegmentationWorkflow(
+            input_path=path, input_key="bmap", ws_path=path,
+            ws_key=f"ws_{tag}", problem_path=str(tmp_path / f"p_{tag}.n5"),
+            output_path=path, output_key=f"seg_{tag}",
+            tmp_folder=str(tmp_path / f"tmp_{tag}"),
+            config_dir=config_dir, max_jobs=2, target="tpu",
+            n_scales=1, fused=True)
+        assert ctt.build([mc], raise_on_failure=True)
+        with file_reader(path, "r") as f:
+            seg = f[f"seg_{tag}"][:]
+        with open(str(tmp_path / f"tmp_{tag}" /
+                      "fused_segmentation.status")) as f:
+            status = json.load(f)
+        return seg, status
+
+    before = dict(rt.EXEC_CACHE_STATS)
+    seg_b, st_b = run("block", "block")
+    seg_m, st_m = run("mesh", "mesh")
+
+    # exactly ONE sharded program compiled for the volume, ONE
+    # steady-state wait (the blockwise path waits once per block)
+    waits_m = st_m["stage_counts"]["sync-execute"]
+    waits_b = st_b["stage_counts"]["sync-execute"]
+    assert waits_m == 1 and waits_b > 1, (waits_m, waits_b)
+
+    # warm re-run: zero additional compiles, pure cache hit
+    mid = dict(rt.EXEC_CACHE_STATS)
+    seg_m2, _ = run("mesh", "mesh2")
+    after = dict(rt.EXEC_CACHE_STATS)
+    assert after["compiles"] == mid["compiles"]
+    assert after["hits"] > mid["hits"]
+    np.testing.assert_array_equal(seg_m2, seg_m)
+
+    # the problem container records the slab decomposition
+    with file_reader(str(tmp_path / "p_mesh.n5"), "r") as f:
+        assert list(f["s0/graph"].attrs["sub_graph_block_shape"]) == \
+            [12, 128, 128]
+
+    def voi(seg):
+        t = ContingencyTable.from_arrays_chunked(gt, seg)
+        vs, vm, are, _ = cremi_score_from_table(t)
+        return vs + vm, are
+
+    v_b, r_b = voi(seg_b)
+    v_m, r_m = voi(seg_m)
+    assert r_b < 0.1 and r_m < 0.1, (r_b, r_m)
+    assert abs(v_b - v_m) <= 0.01, (v_b, v_m)

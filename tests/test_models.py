@@ -27,6 +27,7 @@ def test_mesh_factorization():
     assert np.prod(_factorize(6)) == 6
 
 
+@pytest.mark.mesh
 def test_sharded_train_step_runs_and_learns():
     from cluster_tools_tpu.models.train import train_step_for_mesh
 
@@ -63,6 +64,8 @@ def test_halo_exchange_matches_padded_stencil():
     np.testing.assert_allclose(out, expect, rtol=1e-6)
 
 
+@pytest.mark.slow
+@pytest.mark.mesh
 def test_sharded_train_state_checkpoint_roundtrip(tmp_path):
     """Orbax train-state checkpointing over the 8-device mesh: save the
     sharded state after one step, restore onto the same shardings, and

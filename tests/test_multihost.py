@@ -7,6 +7,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from cluster_tools_tpu.core.storage import file_reader
 from cluster_tools_tpu.core.workflow import build
@@ -278,6 +279,11 @@ def test_two_process_cross_process_psum(tmp_path):
                               stderr=subprocess.STDOUT)
              for pid in range(2)]
     outs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    if any("Multiprocess computations aren't implemented" in o
+           for o in outs):
+        # this jaxlib's CPU backend has no cross-process collectives
+        # (gloo-less build) — the path is exercised on real multihost
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
     assert all("cross-process psum ok" in o for o in outs), outs[0][-500:]

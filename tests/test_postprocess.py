@@ -5,6 +5,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from cluster_tools_tpu.core.storage import file_reader
 from cluster_tools_tpu.core.workflow import build
@@ -45,6 +46,7 @@ def test_size_filter_background(tmp_workdir, tmp_path):
         assert (out[(seg == lbl)] == lbl).all()
 
 
+@pytest.mark.slow
 def test_size_filter_filling(tmp_workdir, tmp_path):
     from cluster_tools_tpu.workflows.postprocess import SizeFilterWorkflow
 

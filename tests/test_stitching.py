@@ -146,6 +146,7 @@ def test_simple_stitching_merges_boundary_edges(tmp_workdir, tmp_path):
         assert len(np.unique(merged[truth == cell])) == 1
 
 
+@pytest.mark.slow
 def test_two_pass_watershed(tmp_workdir, tmp_path):
     from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
     from tests.test_watershed import _boundary_volume
@@ -175,6 +176,7 @@ def test_two_pass_watershed(tmp_workdir, tmp_path):
     assert 2 <= len(uniques) < 300
 
 
+@pytest.mark.slow
 def test_watershed_from_seeds(tmp_workdir, tmp_path):
     from cluster_tools_tpu.workflows.watershed import WatershedFromSeedsTask
 

@@ -145,6 +145,7 @@ def test_watershed_workflow_end_to_end(tmp_workdir, tmp_path, target):
     assert 2 <= len(uniques) < 500
 
 
+@pytest.mark.slow
 def test_watershed_workflow_with_mask(tmp_workdir, tmp_path):
     tmp_folder, config_dir = tmp_workdir
     shape = (20, 20, 20)
@@ -172,6 +173,7 @@ def test_watershed_workflow_with_mask(tmp_workdir, tmp_path):
     assert (ws[:, :10, :] > 0).all()
 
 
+@pytest.mark.slow
 def test_watershed_label_offsets_never_collide(tmp_workdir, tmp_path):
     """Halo larger than the block: uncompacted outer-block CC roots would
     exceed the offset unit and collide across blocks (regression)."""
@@ -234,6 +236,7 @@ def test_watershed_2d_mode_slices_independent(tmp_workdir, tmp_path):
         assert len(zs) == 1, f"label {lab} spans slices {zs}"
 
 
+@pytest.mark.slow
 def test_streamed_pipeline_matches_blockwise():
     """run_ws_blocks_stream (the fused bench/deployment path) produces the
     same fragments as run_ws_block on the 3d no-mask path."""
@@ -249,6 +252,7 @@ def test_streamed_pipeline_matches_blockwise():
     np.testing.assert_array_equal(streamed[1], single)
 
 
+@pytest.mark.slow
 def test_watershed_fragment_purity():
     """Regression: the priority-flood fill must not leak labels across
     ridges (the unordered fill silently merged basins: interior purity
@@ -307,6 +311,7 @@ def test_suppress_maxima():
                                np.zeros(0))) == 0
 
 
+@pytest.mark.slow
 def test_watershed_nms_reduces_fragments(tmp_workdir, tmp_path):
     """non_maximum_suppression merges duplicate seeds on broad plateaus ->
     fewer fragments, still a complete (no zeros) labeling."""
@@ -328,6 +333,7 @@ def test_watershed_nms_reduces_fragments(tmp_workdir, tmp_path):
     assert n_nms >= 1
 
 
+@pytest.mark.slow
 def test_streamed_pipeline_matches_blockwise_with_size_filter():
     """Both streamed size-filter paths — fused on-device (bincount + regrow
     inside the jitted pipeline, the accelerator default) and host-side (the
@@ -390,6 +396,7 @@ def test_edt_axes_and_vmap_safety():
     np.testing.assert_allclose(out, want, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_host_watershed_block_quality():
     """run_ws_block_host (scipy reference-faithful path) segments the
     synthetic boundary volume comparably to the device path."""

@@ -24,6 +24,7 @@ def _instance(shape, n_cells=10, seed=0):
     return ndimage.gaussian_filter(bnd, 1.0).astype("float32")
 
 
+@pytest.mark.slow
 def test_pipelined_drain_bit_identical(tmp_path, tmp_workdir):
     """writer_threads=4 / stream_window=3 (pipelined) vs writer_threads=0 /
     stream_window=1 (fully sequential): same fragments, same maxId, same
