@@ -274,6 +274,14 @@ MESH_BLOCK = list(_env_shape("BENCH_MESH_BLOCK", (16, 64, 64)))
 MESH_DEVICES = tuple(int(d) for d in os.environ.get(
     "BENCH_MESH_DEVICES", "1,2,4,8").split(","))
 
+# VOI-parity bars of the mesh series (reconciled r8; BASELINE.md
+# "Mesh-resident mode"): the deployed configuration — the FULL mesh —
+# carries the strict 0.01 gate; partial-mesh rows are the seam-count
+# ablation (fewer slab seams than the block grid; devices=1 has ZERO
+# seams) and carry a sanity bound only
+VOI_GATE_FULL_MESH = 0.01
+VOI_GATE_PARTIAL_MESH = 0.05
+
 
 def run_mesh_chain(store_path, workdir, mesh_resident, n_devices):
     """One flagship run (optionally mesh-resident) returning
@@ -313,8 +321,30 @@ def run_mesh_chain(store_path, workdir, mesh_resident, n_devices):
     return elapsed, seg, status
 
 
-def _run_mesh_subprocess(store_path, workdir, mesh_resident, n_devices):
-    """run_mesh_chain in a subprocess with an n_devices virtual mesh."""
+def _subprocess_env(extra_env=None, strip_exec_cache=True):
+    """Sanitized env for bench subprocesses: accelerator-plugin site dirs
+    out of PYTHONPATH, and (by default) the persistent executable cache
+    stripped so compile-measuring configs stay cold.  ONE home for this
+    logic — the mesh and warm harnesses must not drift apart."""
+    env = dict(os.environ)
+    if strip_exec_cache:
+        env.pop("CTT_EXEC_CACHE_DIR", None)
+    env.update(extra_env or {})
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p)
+    return env
+
+
+def _run_mesh_subprocess(store_path, workdir, mesh_resident, n_devices,
+                         extra_env=None):
+    """run_mesh_chain in a subprocess with an n_devices virtual mesh.
+
+    The persistent executable cache env is STRIPPED by default: the mesh
+    series measures the dispatch model INCLUDING the one-time compile,
+    and an inherited warm disk tier would silently zero `sync-compile`.
+    The warm bench opts back in through ``extra_env``.
+    """
     import pickle
 
     os.makedirs(workdir, exist_ok=True)
@@ -338,11 +368,8 @@ t, seg, status = bench.run_mesh_chain(
 with open({out_path!r}, "wb") as fo:
     pickle.dump((t, seg, status), fo)
 """)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and ".axon_site" not in p)
-    rc = subprocess.call([sys.executable, script], env=env)
+    rc = subprocess.call([sys.executable, script],
+                         env=_subprocess_env(extra_env))
     assert rc == 0, f"mesh chain failed (devices={n_devices})"
     with open(out_path, "rb") as f:
         return pickle.load(f)
@@ -414,13 +441,17 @@ def main_mesh():
     # seam-count ablation — fewer devices mean fewer slab seams than
     # the block grid (devices=1: ZERO seams), so on a smoke-sized
     # instance (~10 cells) their partitions legitimately diverge by
-    # more than the parity budget; they carry a sanity bound only
-    for row in rows:
-        assert row["voi_delta_vs_blockwise"] <= 0.05, row
-        assert row["stage_counts"].get("sync-execute") == 1, row
+    # more than the parity budget; they carry a sanity bound only.
+    # Each row RECORDS the bound it was gated against (``voi_gate``) so
+    # the committed artifact is self-describing — a 0.03 delta on a
+    # 1-device ablation row is inside ITS bar, not a missed 0.01 gate
     full_mesh = max(rows, key=lambda r: r["devices"])
+    for row in rows:
+        row["voi_gate"] = VOI_GATE_FULL_MESH if row is full_mesh \
+            else VOI_GATE_PARTIAL_MESH
+        assert row["voi_delta_vs_blockwise"] <= row["voi_gate"], row
+        assert row["stage_counts"].get("sync-execute") == 1, row
     assert full_mesh["devices"] >= 4, full_mesh
-    assert full_mesh["voi_delta_vs_blockwise"] <= 0.01, full_mesh
     assert block_entry["stage_counts"].get("sync-execute", 0) > 1, \
         block_entry
 
@@ -435,6 +466,17 @@ def main_mesh():
                  "program and ONE sync-execute wait per volume vs one "
                  "per block — not chip speedup; see BASELINE.md "
                  "'Mesh-resident mode'"),
+        "gates": {
+            "voi_delta_full_mesh": VOI_GATE_FULL_MESH,
+            "voi_delta_partial_mesh": VOI_GATE_PARTIAL_MESH,
+            "note": ("strict VOI parity is gated on the FULL mesh (the "
+                     "deployed configuration); partial-mesh rows are the "
+                     "seam-count ablation — fewer z-slab seams than the "
+                     "block grid (devices=1: zero seams) legitimately "
+                     "shift the partition on a smoke-sized instance, so "
+                     "they carry a sanity bound only (each row records "
+                     "its own voi_gate)"),
+        },
         "per_block": block_entry,
         "mesh": rows,
     }
@@ -454,6 +496,239 @@ def main_mesh():
                           "mesh": [r["stage_counts"].get("sync-execute")
                                    for r in rows]},
                       "detail": os.path.basename(path)}))
+
+
+# ---------------------------------------------------------------------------
+# `warm` config: compile amortization through the PERSISTENT executable
+# cache (core.runtime compile_cached disk tier).  Three measurements, each
+# in its own fresh process so nothing is warm except the DISK:
+#
+#   1. cold  — mesh-resident flagship, empty cache dir: pays the full XLA
+#              build (sync-compile) and populates the disk tier;
+#   2. warm  — the SAME run again in a fresh process: sync-compile is a
+#              deserialize, the wall collapses to execute + host tail;
+#   3. tenants — the resident multi-tenant server (core/server.py):
+#              N tenants issue small ROI requests; the FIRST request pays
+#              the compile (cold request latency), later requests are
+#              pure cache hits (warm latency) — run twice, so the second
+#              harness process also shows the first request warm via disk.
+#
+# On this 1-core emulated mesh the numbers measure COMPILE AMORTIZATION
+# (the dispatch/caching model), not chip speed — see BASELINE.md
+# "Warm-path semantics".  Invoke with `python bench.py warm`; writes
+# BENCH_warm.json.
+# ---------------------------------------------------------------------------
+
+WARM_ROI_SHAPE = _env_shape("BENCH_WARM_ROI", (16, 64, 64))
+WARM_TENANTS = max(int(os.environ.get("BENCH_WARM_TENANTS", "2")), 2)
+# >= 2: wave 0 is the cold measurement, later waves are the warm ones
+WARM_WAVES = max(int(os.environ.get("BENCH_WARM_WAVES", "3")), 2)
+
+
+def _run_tenant_harness(workdir, cache_dir, n_tenants, n_waves):
+    """The multi-tenant server harness in a fresh subprocess: returns
+    {"waves": [[{tenant, latency_s, queue_wait_s, exec_cache}, ...], ...],
+    "exec_cache_total": ...}.  Requests are issued in WAVES (one request
+    per tenant, wait for all, repeat) so per-request latency is
+    queue-comparable across waves."""
+    os.makedirs(workdir, exist_ok=True)
+    out_path = os.path.join(workdir, "result.json")
+    script = os.path.join(workdir, "harness.py")
+    with open(script, "w") as f:
+        f.write(f"""
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
+sys.path = [p for p in sys.path if ".axon_site" not in p]
+import numpy as np
+import bench
+from cluster_tools_tpu.core import runtime as rt
+from cluster_tools_tpu.core.server import (FusedROIPipeline,
+                                           ResidentSegmentationServer)
+
+shape = {tuple(WARM_ROI_SHAPE)!r}
+_, bnd = bench.synthetic_instance(shape, seed=7)
+vol = np.round(bnd * 255).astype("uint8")
+pipe = FusedROIPipeline(shape, block_shape=tuple(s // 2 for s in shape),
+                        halo=(2, 8, 8))
+waves = []
+with ResidentSegmentationServer({os.path.join(workdir, 'srv')!r},
+                                pipe) as srv:
+    for wave in range({n_waves!r}):
+        handles = [(f"tenant{{i}}", srv.submit(f"tenant{{i}}", vol))
+                   for i in range({n_tenants!r})]
+        rows = []
+        for tenant, h in handles:
+            h.result(600)
+            st = json.load(open(h.status_path))
+            rows.append({{"tenant": tenant,
+                          "latency_s": st["wall_time"],
+                          "queue_wait_s": st["queue_wait_s"],
+                          "exec_cache": st["exec_cache"]}})
+        waves.append(rows)
+with open({out_path!r}, "w") as fo:
+    json.dump({{"waves": waves,
+               "exec_cache_total": rt.exec_cache_snapshot()}}, fo)
+""")
+    rc = subprocess.call([sys.executable, script], env=_subprocess_env(
+        {"CTT_EXEC_CACHE_DIR": cache_dir}))
+    assert rc == 0, "tenant harness failed"
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def main_warm():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from cluster_tools_tpu.core import runtime as _rt
+
+    if _rt._serialize_api() is None:
+        # the warm gates assert on disk_hits/compiles, which presuppose
+        # blob persistence; without serialize_executable the tier runs
+        # in jax-compilation-cache fallback mode (still faster warm, but
+        # compile_cached counts compiles) — fail FAST and say why,
+        # instead of dying on opaque asserts after the expensive runs
+        print(json.dumps({
+            "metric": "warm_path_compile_amortization",
+            "skipped": ("this jax cannot serialize AOT executables; the "
+                        "disk tier runs in jax_compilation_cache_dir "
+                        "fallback mode, which the warm gates cannot "
+                        "assert on")}))
+        return
+    base = "/tmp/ctt_bench_warm"
+    shutil.rmtree(base, ignore_errors=True)
+    os.makedirs(base)
+    cache_dir = os.path.join(base, "exec_cache")
+
+    lab, bnd = synthetic_instance(MESH_SHAPE, seed=0)
+    store = os.path.join(base, "vol.n5")
+    from cluster_tools_tpu.core.storage import file_reader
+
+    with file_reader(store) as f:
+        ds = f.require_dataset("bmap", shape=bnd.shape, chunks=MESH_BLOCK,
+                               dtype="uint8")
+        ds[:] = np.round(bnd * 255).astype("uint8")
+    n_vox = int(np.prod(MESH_SHAPE))
+    n_dev = max(MESH_DEVICES)
+    cache_env = {"CTT_EXEC_CACHE_DIR": cache_dir}
+
+    def flagship_row(tag, t, status):
+        st = status.get("stages") or {}
+        return {"run": tag, "wall_s": round(t, 2),
+                "vox_per_sec": round(n_vox / t, 1),
+                "fused_wall_s": round(status.get("wall_time", 0.0), 2),
+                "sync_compile_s": round(st.get("sync-compile", 0.0), 2),
+                "sync_execute_s": round(st.get("sync-execute", 0.0), 2),
+                "exec_cache": status.get("exec_cache") or {}}
+
+    # 1+2: cold then warm flagship, each in a FRESH process; only the
+    # disk cache dir is shared
+    t_c, seg_c, st_c = _run_mesh_subprocess(
+        store, os.path.join(base, "cold"), True, n_dev,
+        extra_env=cache_env)
+    cold = flagship_row("cold", t_c, st_c)
+    print(json.dumps(cold), file=sys.stderr, flush=True)
+    t_w, seg_w, st_w = _run_mesh_subprocess(
+        store, os.path.join(base, "warm"), True, n_dev,
+        extra_env=cache_env)
+    warm = flagship_row("warm", t_w, st_w)
+    print(json.dumps(warm), file=sys.stderr, flush=True)
+
+    # identical results cold vs warm: the deserialized executable IS the
+    # compiled one
+    np.testing.assert_array_equal(seg_c, seg_w)
+
+    # 3: multi-tenant server harness — cold-cache process, then a second
+    # process against the now-populated disk tier
+    tenants_cold = _run_tenant_harness(
+        os.path.join(base, "tenants_cold"), cache_dir,
+        WARM_TENANTS, WARM_WAVES)
+    tenants_warm = _run_tenant_harness(
+        os.path.join(base, "tenants_warm"), cache_dir,
+        WARM_TENANTS, WARM_WAVES)
+
+    def wave_latencies(h):
+        return [[round(r["latency_s"], 2) for r in wave]
+                for wave in h["waves"]]
+
+    cold_req = max(r["latency_s"] for r in tenants_cold["waves"][0])
+    warm_reqs = [r["latency_s"] for wave in tenants_cold["waves"][1:]
+                 for r in wave]
+    warm_req = float(sorted(warm_reqs)[len(warm_reqs) // 2])
+    disk_first_req = max(r["latency_s"]
+                         for r in tenants_warm["waves"][0])
+
+    # ---- gates (the ISSUE acceptance) --------------------------------
+    assert warm["sync_compile_s"] <= 0.10 * cold["sync_compile_s"], \
+        (warm["sync_compile_s"], cold["sync_compile_s"])
+    assert cold["wall_s"] / warm["wall_s"] >= 3.0, (cold, warm)
+    assert warm["exec_cache"].get("disk_hits", 0) >= 1, warm
+    assert warm["exec_cache"].get("compiles", 0) == 0, warm
+    assert cold["exec_cache"].get("compiles", 0) >= 1, cold
+    served = {r["tenant"] for wave in tenants_cold["waves"] for r in wave}
+    assert len(served) >= 2, served
+    assert warm_req < 0.5 * cold_req, (warm_req, cold_req)
+    # the populated disk tier also makes a fresh server process warm:
+    # its FIRST request deserializes instead of compiling
+    assert disk_first_req < 0.5 * cold_req, (disk_first_req, cold_req)
+
+    out = {
+        "metric": "warm_path_compile_amortization",
+        "shape": list(MESH_SHAPE),
+        "block_shape": MESH_BLOCK,
+        "volume_mvox": round(n_vox / 1e6, 2),
+        "devices": n_dev,
+        "note": ("persistent executable cache (compile_cached disk "
+                 "tier): cold vs warm are IDENTICAL runs in fresh "
+                 "processes sharing only the cache dir.  On this 1-core "
+                 "emulated mesh the ratio measures compile "
+                 "amortization, not chip speed — see BASELINE.md "
+                 "'Warm-path semantics'"),
+        "flagship": {
+            "cold": cold, "warm": warm,
+            "warm_speedup": round(t_c / t_w, 2),
+            "sync_compile_ratio": round(
+                warm["sync_compile_s"] / max(cold["sync_compile_s"],
+                                             1e-9), 4),
+            "bitwise_identical": True,
+        },
+        "tenants": {
+            "roi_shape": list(WARM_ROI_SHAPE),
+            "n_tenants": WARM_TENANTS,
+            "waves_per_process": WARM_WAVES,
+            "cold_process": {
+                "wave_latencies_s": wave_latencies(tenants_cold),
+                "cold_request_s": round(cold_req, 2),
+                "warm_request_median_s": round(warm_req, 2),
+                "exec_cache_total": tenants_cold["exec_cache_total"],
+            },
+            "warm_process": {
+                "wave_latencies_s": wave_latencies(tenants_warm),
+                "first_request_s": round(disk_first_req, 2),
+                "exec_cache_total": tenants_warm["exec_cache_total"],
+            },
+        },
+        "gates": {
+            "warm_sync_compile_max_frac": 0.10,
+            "warm_wall_min_speedup": 3.0,
+            "warm_request_max_frac_of_cold": 0.5,
+            "min_tenants": 2,
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_warm.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({
+        "metric": out["metric"],
+        "cold_wall_s": cold["wall_s"], "warm_wall_s": warm["wall_s"],
+        "warm_speedup": out["flagship"]["warm_speedup"],
+        "sync_compile_s": {"cold": cold["sync_compile_s"],
+                           "warm": warm["sync_compile_s"]},
+        "tenant_request_s": {"cold": round(cold_req, 2),
+                             "warm": round(warm_req, 2),
+                             "fresh_process_warm_disk":
+                                 round(disk_first_req, 2)},
+        "detail": os.path.basename(path)}))
 
 
 def main():
@@ -636,5 +911,7 @@ def main():
 if __name__ == "__main__":
     if os.environ.get("BENCH_MESH") or "mesh" in sys.argv[1:]:
         main_mesh()
+    elif os.environ.get("BENCH_WARM") or "warm" in sys.argv[1:]:
+        main_warm()
     else:
         main()

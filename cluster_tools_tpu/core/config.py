@@ -32,6 +32,13 @@ def default_global_config() -> Dict[str, Any]:
         "mesh_shape": None,        # e.g. [2, 4]; None = all local devices, 1-d
         "mesh_axis_names": None,   # e.g. ["z", "y"]
         "precision": "bfloat16",
+        # persistent executable cache (core.runtime compile_cached disk
+        # tier): a directory makes AOT-compiled device programs survive
+        # the process — warm re-runs deserialize instead of recompiling.
+        # None = memory-only (the CTT_EXEC_CACHE_DIR env var can still
+        # activate it); max_bytes None = runtime default (2 GiB LRU)
+        "exec_cache_dir": None,
+        "exec_cache_max_bytes": None,
     }
 
 

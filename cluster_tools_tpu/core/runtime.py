@@ -174,34 +174,295 @@ def parse_stage_times(log_path: str, line_tag: str = _STAGE_LINE
 # process.  The counters make dispatch behavior assertable: the mesh-resident
 # flagship must compile exactly ONE program per volume (tests/bench check
 # ``EXEC_CACHE_STATS``), and warm-path requests must be pure cache hits.
+#
+# PERSISTENT DISK TIER (r7): the in-memory cache dies with the process, and
+# on this stack the compile IS the wall (BENCH_mesh: 36-45 s of a ~43-51 s
+# run).  When a cache directory is configured (``exec_cache_configure``, the
+# ``exec_cache_dir`` global config, or ``CTT_EXEC_CACHE_DIR``), executables
+# are serialized via ``jax.experimental.serialize_executable`` and keyed by
+# a content digest of (jaxlib/jax version, backend + device topology, the
+# logical cache key) — any toolchain or topology bump changes the digest and
+# simply misses.  Loads are corruption-safe (a bad blob is deleted and the
+# program recompiles; never a crash) and the directory is size-bounded with
+# mtime-LRU eviction.  On jax versions without ``serialize_executable`` the
+# shim falls back to enabling jax's own persistent compilation cache
+# (``jax_compilation_cache_dir``), which accelerates lower().compile()
+# transparently instead.
 # ---------------------------------------------------------------------------
 
 _EXEC_CACHE: Dict[Any, Any] = {}
-EXEC_CACHE_STATS: Dict[str, int] = {"compiles": 0, "hits": 0}
+EXEC_CACHE_STATS: Dict[str, Any] = {
+    "compiles": 0, "hits": 0,
+    # disk tier: hits/misses only count when a disk tier is configured;
+    # deserialize_s is the wall spent re-loading executables from disk
+    # (the warm path pays THIS instead of the XLA build)
+    "disk_hits": 0, "disk_misses": 0, "disk_writes": 0,
+    "disk_evictions": 0, "deserialize_s": 0.0,
+}
+
+#: explicit runtime overrides (exec_cache_configure); env vars are read at
+#: call time so subprocess workers inherit the driver's configuration
+_DISK_TIER: Dict[str, Any] = {"dir": None, "max_bytes": None,
+                              "jax_fallback": False}
+_DISK_SUFFIX = ".jexec"
+_DEFAULT_DISK_BYTES = 2 << 30   # 2 GiB: ~700 resident-program blobs
 
 
-def compile_cached(key, build_fn):
-    """Return the cached AOT executable for ``key``, building it with
-    ``build_fn()`` (typically ``lambda: prog.lower(*args).compile()``) on
-    the first request.  Thread-safe for the single-driver usage pattern;
-    increments ``EXEC_CACHE_STATS['compiles' | 'hits']``."""
-    ent = _EXEC_CACHE.get(key)
-    if ent is None:
-        ent = build_fn()
-        _EXEC_CACHE[key] = ent
-        EXEC_CACHE_STATS["compiles"] += 1
-    else:
-        EXEC_CACHE_STATS["hits"] += 1
+def exec_cache_configure(cache_dir: Optional[str] = None,
+                         max_bytes: Optional[int] = None) -> None:
+    """Activate (or retarget) the persistent disk tier.  ``cache_dir=None``
+    deactivates the explicit override (the ``CTT_EXEC_CACHE_DIR`` env var,
+    if set, still applies).  When the running jax cannot serialize
+    executables, the same directory is handed to jax's persistent
+    compilation cache instead — warm processes then skip the XLA backend
+    compile inside ``lower().compile()`` rather than the whole build."""
+    _DISK_TIER["dir"] = cache_dir
+    _DISK_TIER["max_bytes"] = max_bytes
+    if cache_dir and _serialize_api() is None:
+        _enable_jax_fallback_cache(cache_dir)
+        _DISK_TIER["jax_fallback"] = True
+    elif not cache_dir and _DISK_TIER["jax_fallback"]:
+        # deactivation must be symmetric: un-point jax's persistent
+        # cache (it would otherwise keep writing to a dir the caller
+        # believes released — e.g. a deleted pytest tmp dir)
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass
+        _DISK_TIER["jax_fallback"] = False
+
+
+def _exec_cache_dir() -> Optional[str]:
+    return _DISK_TIER["dir"] or os.environ.get("CTT_EXEC_CACHE_DIR") or None
+
+
+def _exec_cache_max_bytes() -> int:
+    if _DISK_TIER["max_bytes"]:
+        return int(_DISK_TIER["max_bytes"])
+    env = os.environ.get("CTT_EXEC_CACHE_MAX_BYTES")
+    return int(env) if env else _DEFAULT_DISK_BYTES
+
+
+def _serialize_api():
+    """The executable-serialization module, or None on jax versions that
+    cannot serialize AOT executables (version shim, like pvary/axis_size
+    in parallel/stencil.py)."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        if hasattr(se, "serialize") and hasattr(se, "deserialize_and_load"):
+            return se
+    except Exception:
+        pass
+    return None
+
+
+def _enable_jax_fallback_cache(cache_dir: str) -> None:
+    """Fallback tier for jax versions without serialize_executable: point
+    jax's own persistent compilation cache at the directory, so XLA
+    backend compiles (the dominant cost) are reused across processes."""
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        except Exception:
+            pass  # knob renamed/absent on some versions: cache still works
+    except Exception:
+        pass  # no jax at all: nothing to accelerate
+
+
+def _exec_cache_fingerprint() -> str:
+    """Invalidation scope of a persisted executable: serialized programs
+    bind to the exact compiler version and device topology, so all of it
+    goes into the digest — a jaxlib bump or different mesh is a MISS."""
+    try:
+        import jax
+        import jaxlib
+
+        devs = jax.devices()
+        topo = (jax.default_backend(), len(devs),
+                getattr(devs[0], "device_kind", "") if devs else "")
+        return repr((jax.__version__, jaxlib.__version__, topo))
+    except Exception:
+        return "no-jax"
+
+
+def _exec_cache_path(key) -> str:
+    import hashlib
+
+    digest = hashlib.sha256(
+        (repr(key) + "|" + _exec_cache_fingerprint()).encode()).hexdigest()
+    return os.path.join(_exec_cache_dir(), digest[:32] + _DISK_SUFFIX)
+
+
+def _disk_load(key):
+    """The persisted executable for ``key``, or None.  NEVER raises: any
+    failure (missing, truncated, version-skewed, undeserializable) deletes
+    the blob where possible and reports a miss — a corrupt cache must cost
+    one recompile, not the run."""
+    se = _serialize_api()
+    if se is None:
+        return None
+    path = _exec_cache_path(key)
+    if not os.path.exists(path):
+        EXEC_CACHE_STATS["disk_misses"] += 1
+        return None
+    import pickle
+
+    t0 = time.perf_counter()
+    try:
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.loads(f.read())
+        ent = se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:
+        EXEC_CACHE_STATS["disk_misses"] += 1
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+    EXEC_CACHE_STATS["disk_hits"] += 1
+    EXEC_CACHE_STATS["deserialize_s"] = round(
+        EXEC_CACHE_STATS["deserialize_s"]
+        + (time.perf_counter() - t0), 4)
+    try:
+        os.utime(path)   # LRU recency for the eviction scan
+    except OSError:
+        pass
     return ent
 
 
-def exec_cache_clear() -> None:
+def _disk_store(key, ent) -> None:
+    """Persist ``ent`` (best-effort: executables that cannot serialize —
+    e.g. callbacks capturing host state — just stay memory-only)."""
+    se = _serialize_api()
+    if se is None:
+        return
+    import pickle
+
+    try:
+        blob = pickle.dumps(se.serialize(ent))
+    except Exception:
+        return
+    cache_dir = _exec_cache_dir()
+    path = _exec_cache_path(key)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = path + ".tmp%d" % os.getpid()
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)   # atomic: readers never see a torn blob
+    except OSError:
+        return
+    EXEC_CACHE_STATS["disk_writes"] += 1
+    _disk_evict(_exec_cache_max_bytes())
+
+
+def _disk_evict(max_bytes: int) -> None:
+    """mtime-LRU eviction down to the size bound (reads touch mtime)."""
+    cache_dir = _exec_cache_dir()
+    try:
+        entries = []
+        for name in os.listdir(cache_dir):
+            if not name.endswith(_DISK_SUFFIX):
+                continue
+            p = os.path.join(cache_dir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, p))
+    except OSError:
+        return
+    total = sum(e[1] for e in entries)
+    for mtime, size, p in sorted(entries):
+        if total <= max_bytes:
+            break
+        try:
+            os.remove(p)
+            EXEC_CACHE_STATS["disk_evictions"] += 1
+            total -= size
+        except OSError:
+            pass
+
+
+def compile_cached(key, build_fn, persist: bool = True):
+    """Return the cached AOT executable for ``key``, building it with
+    ``build_fn()`` (typically ``lambda: prog.lower(*args).compile()``) on
+    the first request.  Thread-safe for the single-driver usage pattern;
+    increments ``EXEC_CACHE_STATS['compiles' | 'hits']``.
+
+    With a disk tier configured (see ``exec_cache_configure``) a memory
+    miss first tries the persisted blob for this key (counted under
+    ``disk_hits``/``disk_misses``, load wall under ``deserialize_s``) and
+    a fresh build is persisted for future processes.  ``persist=False``
+    opts a call out of the disk tier (memory-only semantics)."""
+    ent = _EXEC_CACHE.get(key)
+    if ent is not None:
+        EXEC_CACHE_STATS["hits"] += 1
+        return ent
+    disk = persist and _exec_cache_dir() is not None
+    if disk and _serialize_api() is None and not _DISK_TIER["jax_fallback"]:
+        # env-var activation (CTT_EXEC_CACHE_DIR) never went through
+        # exec_cache_configure — wire the version-shim fallback here so
+        # the documented behavior holds for BOTH activation paths
+        _enable_jax_fallback_cache(_exec_cache_dir())
+        _DISK_TIER["jax_fallback"] = True
+    if disk:
+        ent = _disk_load(key)
+        if ent is not None:
+            _EXEC_CACHE[key] = ent
+            return ent
+    ent = build_fn()
+    _EXEC_CACHE[key] = ent
+    EXEC_CACHE_STATS["compiles"] += 1
+    if disk:
+        _disk_store(key, ent)
+    return ent
+
+
+def exec_cache_snapshot() -> Dict[str, Any]:
+    return dict(EXEC_CACHE_STATS)
+
+
+def exec_cache_delta(before: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-task cache activity: the counter movement since ``before``
+    (only non-zero entries — most tasks never touch the executor cache)."""
+    out = {}
+    for k, v in EXEC_CACHE_STATS.items():
+        d = v - before.get(k, 0)
+        if isinstance(v, float):
+            if d > 1e-4:
+                out[k] = round(d, 3)
+        elif d > 0:
+            out[k] = d
+    return out
+
+
+def exec_cache_clear(disk: bool = False) -> None:
     """Reset the executable cache AND its counters together (a clear that
     kept stale compile/hit counts would skew the dispatch-model
-    assertions the counters exist for)."""
+    assertions the counters exist for).  ``disk=True`` also purges the
+    persisted blobs of the configured disk tier — the full
+    cold-start reset the warm-path bench uses between cold trials."""
     _EXEC_CACHE.clear()
-    EXEC_CACHE_STATS["compiles"] = 0
-    EXEC_CACHE_STATS["hits"] = 0
+    for k in EXEC_CACHE_STATS:
+        EXEC_CACHE_STATS[k] = 0.0 if k == "deserialize_s" else 0
+    if disk:
+        cache_dir = _exec_cache_dir()
+        if cache_dir and os.path.isdir(cache_dir):
+            for name in os.listdir(cache_dir):
+                if name.endswith(_DISK_SUFFIX) or _DISK_SUFFIX + ".tmp" \
+                        in name:
+                    try:
+                        os.remove(os.path.join(cache_dir, name))
+                    except OSError:
+                        pass
 
 
 def log(msg: str, stream=None) -> None:
@@ -559,6 +820,13 @@ class BlockTask(Task):
         self.global_config = self._cfg.global_config()
         self.task_config = self._cfg.task_config(
             self.task_name, self.default_task_config())
+        # persistent executable cache is deployment opt-in: activating it
+        # from the global config wires every device task in the workflow
+        # (including the fused/mesh-resident programs) to the disk tier
+        if self.global_config.get("exec_cache_dir"):
+            exec_cache_configure(
+                self.global_config["exec_cache_dir"],
+                self.global_config.get("exec_cache_max_bytes"))
         os.makedirs(self.tmp_folder, exist_ok=True)
         os.makedirs(os.path.join(self.tmp_folder, "logs"), exist_ok=True)
 
@@ -693,6 +961,7 @@ class BlockTask(Task):
             self._attempt_stages = stages_snapshot()
             self._attempt_bytes = bytes_snapshot()
             self._attempt_counts = counts_snapshot()
+            self._attempt_exec = exec_cache_snapshot()
         stages_before = self._attempt_stages
         executor.run(self, list(range(n_jobs)))
         elapsed = time.time() - self._attempt_t0
@@ -704,7 +973,8 @@ class BlockTask(Task):
             self._write_status(n_jobs, block_list, elapsed,
                                stages_delta(stages_before),
                                bytes_delta(self._attempt_bytes),
-                               counts_delta(self._attempt_counts))
+                               counts_delta(self._attempt_counts),
+                               exec_cache_delta(self._attempt_exec))
             return
 
         if (not self.allow_retry
@@ -797,6 +1067,7 @@ class BlockTask(Task):
             self._attempt_stages = stages_snapshot()
             self._attempt_bytes = bytes_snapshot()
             self._attempt_counts = counts_snapshot()
+            self._attempt_exec = exec_cache_snapshot()
         stages_before = self._attempt_stages
         if my_jobs:
             executor.run(self, my_jobs)
@@ -848,7 +1119,8 @@ class BlockTask(Task):
             self._write_status(n_jobs, block_list, elapsed,
                                stages_delta(stages_before),
                                bytes_delta(self._attempt_bytes),
-                               counts_delta(self._attempt_counts))
+                               counts_delta(self._attempt_counts),
+                               exec_cache_delta(self._attempt_exec))
         # peers must not observe the task incomplete (build() verifies
         # the target right after run) — wait for the lead's write
         mh.fs_barrier(self.tmp_folder, f"{self.name_with_id}_status")
@@ -869,7 +1141,8 @@ class BlockTask(Task):
     def _write_status(self, n_jobs: int, block_list, elapsed: float,
                       stages: Optional[Dict[str, float]] = None,
                       moved_bytes: Optional[Dict[str, float]] = None,
-                      stage_counts: Optional[Dict[str, int]] = None) -> None:
+                      stage_counts: Optional[Dict[str, int]] = None,
+                      exec_cache: Optional[Dict[str, Any]] = None) -> None:
         runtimes = [parse_job_runtime(self.log_path(j)) for j in range(n_jobs)]
         runtimes = [r for r in runtimes if r is not None]
         # subprocess workers report their stages through the job log (the
@@ -915,6 +1188,11 @@ class BlockTask(Task):
             # one per block)
             "stage_counts": {k: int(v) for k, v in sorted(
                 stage_counts.items(), key=lambda kv: -kv[1])},
+            # executable-cache activity attributed to THIS task (memory/
+            # disk hits vs compiles, deserialize wall): warm vs cold
+            # dispatch is assertable per task, the same way stage_counts
+            # made wait counts assertable
+            "exec_cache": dict(exec_cache or {}),
         }
         config_mod.write_config(self.output().path, status)
 

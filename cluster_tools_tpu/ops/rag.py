@@ -687,6 +687,29 @@ N_FEATURES = len(FEATURE_NAMES)
 _QS = (0.1, 0.25, 0.5, 0.75, 0.9)
 
 
+def unique_pairs(u: np.ndarray, v: np.ndarray):
+    """Deduplicated ``(u, v)`` rows plus inverse indices.
+
+    Packed-u64-key path (``np.unique`` on a 1-D key array is ~10x the
+    ``axis=0`` structured sort at face-table sizes) with a structured
+    fallback for ids past 2^32.  ONE home for the idiom — the fused face
+    assembly and the server's in-memory tail both merge edge tables
+    through it."""
+    u = np.asarray(u)
+    v = np.asarray(v)
+    if len(v) == 0:
+        return np.zeros((0, 2), "uint64"), np.zeros((0,), "int64")
+    if v.max() < (1 << 32):
+        keys = (u.astype("uint64") << np.uint64(32)) | v.astype("uint64")
+        ukeys, inv = np.unique(keys, return_inverse=True)
+        uniq = np.stack([ukeys >> np.uint64(32),
+                         ukeys & np.uint64(0xFFFFFFFF)], axis=1)
+    else:
+        pairs = np.stack([u.astype("uint64"), v.astype("uint64")], axis=1)
+        uniq, inv = np.unique(pairs, axis=0, return_inverse=True)
+    return uniq.astype("uint64"), inv
+
+
 def segmented_stats(edge_index: np.ndarray, values: np.ndarray,
                     n_edges: int) -> np.ndarray:
     """Per-edge [mean, var, min, q10..q90, max, count] over samples.

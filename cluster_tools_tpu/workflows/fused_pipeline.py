@@ -108,7 +108,15 @@ _RAW_CACHE: Dict = {}
 #: survives across runs in one driver process (warm-path requests never
 #: pay it again), and ``runtime.EXEC_CACHE_STATS`` counts compiles vs
 #: hits so tests can assert the dispatch model (the mesh-resident path
-#: compiles exactly ONE program per volume)
+#: compiles exactly ONE program per volume).  With the runtime's disk
+#: tier configured (``exec_cache_dir`` global config or
+#: ``CTT_EXEC_CACHE_DIR``), BOTH resident programs — the streamed
+#: per-block executable (`_compiled_resident`) and the mesh-resident
+#: shard_map executable (`_process_mesh`) — persist across processes:
+#: a warm re-run's ``sync-compile`` is a ~0.5 s deserialize instead of
+#: the 35-45 s XLA build (BENCH_warm.json), because the cache keys
+#: below are built ONLY from process-independent values (shapes,
+#: dtypes, config scalars), never from object identities
 
 
 def fragment_cache_get(path: str, key: str, block_id: int,
@@ -1569,23 +1577,14 @@ class FusedFaceAssembly(BlockTask):
                 face_v.extend([v, v])
                 face_x.extend([xa[fg], xb[fg]])
             if face_u:
+                from ..ops.rag import unique_pairs
+
                 fu = np.concatenate(face_u)
                 fv = np.concatenate(face_v)
                 fx = np.concatenate(face_x)
-                # packed u64 keys: np.unique on a 1-D array is ~10x the
-                # axis=0 structured-sort variant at these sizes
-                if fv.max() < (1 << 32):
-                    keys = (fu.astype("uint64") << np.uint64(32)) \
-                        | fv.astype("uint64")
-                    ukeys, inv = np.unique(keys, return_inverse=True)
-                    uniq = np.stack([ukeys >> np.uint64(32),
-                                     ukeys & np.uint64(0xFFFFFFFF)], axis=1)
-                else:  # >4G fragment ids: structured fallback
-                    uv_pairs = np.stack([fu, fv], axis=1)
-                    uniq, inv = np.unique(uv_pairs, axis=0,
-                                          return_inverse=True)
+                uniq, inv = unique_pairs(fu, fv)
                 feats_face = segmented_stats(inv, fx, len(uniq))
-                uv_all = np.concatenate([uv_int, uniq.astype("uint64")])
+                uv_all = np.concatenate([uv_int, uniq])
                 feats_all = np.concatenate([feats_int, feats_face])
             else:
                 uv_all, feats_all = uv_int, feats_int

@@ -27,6 +27,11 @@ os.environ["PYTHONPATH"] = os.pathsep.join(
     p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
     if p and ".axon_site" not in p)
 
+# hermetic executable-cache state: an inherited warm disk tier would turn
+# the suite's compile-count assertions (EXEC_CACHE_STATS / exec_cache
+# status telemetry) into disk hits; tests opt in per-fixture instead
+os.environ.pop("CTT_EXEC_CACHE_DIR", None)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 try:  # if the plugin registered before us (via sitecustomize), unregister it

@@ -174,13 +174,17 @@ def test_mesh_flagship_voi_parity(tmp_path):
     waits_b = st_b["stage_counts"]["sync-execute"]
     assert waits_m == 1 and waits_b > 1, (waits_m, waits_b)
 
-    # warm re-run: zero additional compiles, pure cache hit
+    # warm re-run: zero additional compiles, pure cache hit — and the
+    # per-task exec_cache telemetry in the status JSON says so too
     mid = dict(rt.EXEC_CACHE_STATS)
-    seg_m2, _ = run("mesh", "mesh2")
+    seg_m2, st_m2 = run("mesh", "mesh2")
     after = dict(rt.EXEC_CACHE_STATS)
     assert after["compiles"] == mid["compiles"]
     assert after["hits"] > mid["hits"]
     np.testing.assert_array_equal(seg_m2, seg_m)
+    assert st_m2["exec_cache"].get("compiles", 0) == 0, st_m2["exec_cache"]
+    assert st_m2["exec_cache"].get("hits", 0) >= 1, st_m2["exec_cache"]
+    assert st_m["exec_cache"].get("compiles", 0) >= 1, st_m["exec_cache"]
 
     # the problem container records the slab decomposition
     with file_reader(str(tmp_path / "p_mesh.n5"), "r") as f:

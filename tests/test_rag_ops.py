@@ -133,3 +133,24 @@ def test_hist_dual_matches_two_sample_expansion():
     np.testing.assert_allclose(np.asarray(feats_d)[:nr],
                                np.asarray(feats_e)[:nr], rtol=1e-5,
                                atol=1e-7)
+
+
+def test_unique_pairs_packed_and_fallback():
+    """Shared edge-table dedup helper (fused face assembly + server
+    tail): packed-u64 fast path and >2^32-id structured fallback agree
+    on the (uniq, inverse) contract."""
+    from cluster_tools_tpu.ops.rag import unique_pairs
+
+    u = np.array([1, 2, 1, 3])
+    v = np.array([2, 3, 2, 4])
+    uniq, inv = unique_pairs(u, v)
+    np.testing.assert_array_equal(uniq, [[1, 2], [2, 3], [3, 4]])
+    np.testing.assert_array_equal(uniq[inv],
+                                  np.stack([u, v], 1).astype("uint64"))
+    uniq, inv = unique_pairs(np.array([], "int64"), np.array([], "int64"))
+    assert uniq.shape == (0, 2) and inv.shape == (0,)
+    big_u = np.array([1 << 33, 5, 1 << 33], "uint64")
+    big_v = np.array([1 << 34, 6, 1 << 34], "uint64")
+    uniq, inv = unique_pairs(big_u, big_v)
+    assert len(uniq) == 2
+    np.testing.assert_array_equal(uniq[inv], np.stack([big_u, big_v], 1))

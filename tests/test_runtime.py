@@ -244,3 +244,177 @@ def test_bounded_pool_surfaces_worker_errors():
     pool = BoundedPool(0)
     with pytest.raises(RuntimeError, match="worker failed"):
         pool.submit(boom)
+
+
+# ---------------------------------------------------------------------------
+# persistent executable cache (disk tier): serialize -> deserialize ->
+# execute round trip, corruption safety, LRU bound, disk clear, and the
+# exec_cache telemetry in task status JSONs.  All tests compile TRIVIAL
+# jitted programs (sub-second) — the big resident programs are covered by
+# the warm bench (BENCH_warm.json) and the slow server test.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def exec_disk(tmp_path):
+    """Fresh, isolated disk tier; the session's warm in-memory executables
+    are saved and restored so this fixture never forces later tests to
+    recompile their (expensive) resident programs."""
+    saved_cache = dict(runtime._EXEC_CACHE)
+    saved_stats = dict(runtime.EXEC_CACHE_STATS)
+    runtime._EXEC_CACHE.clear()
+    runtime.exec_cache_clear()
+    d = str(tmp_path / "exec_cache")
+    runtime.exec_cache_configure(d)
+    yield d
+    runtime.exec_cache_configure(None)
+    runtime._EXEC_CACHE.clear()
+    runtime._EXEC_CACHE.update(saved_cache)
+    runtime.EXEC_CACHE_STATS.update(saved_stats)
+
+
+def _trivial_compiled(mult: float = 3.0):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda x: x * mult).lower(jnp.ones((4,))).compile()
+
+
+def _needs_serialization():
+    if runtime._serialize_api() is None:
+        pytest.skip("this jax version cannot serialize AOT executables")
+
+
+def test_exec_cache_disk_roundtrip(exec_disk):
+    """Cold: compile + persist.  After a process-death-equivalent memory
+    clear, the same key deserializes from disk (no recompile) and the
+    loaded executable computes the same results."""
+    _needs_serialization()
+    key = ("triv", 3.0)
+    runtime.compile_cached(key, _trivial_compiled)
+    assert runtime.EXEC_CACHE_STATS["compiles"] == 1
+    assert runtime.EXEC_CACHE_STATS["disk_writes"] == 1
+    assert len(os.listdir(exec_disk)) == 1
+
+    runtime.exec_cache_clear()     # memory only: the blob survives
+    assert len(os.listdir(exec_disk)) == 1
+    ent = runtime.compile_cached(key, _trivial_compiled)
+    assert runtime.EXEC_CACHE_STATS["compiles"] == 0
+    assert runtime.EXEC_CACHE_STATS["disk_hits"] == 1
+    assert runtime.EXEC_CACHE_STATS["deserialize_s"] > 0
+    import jax.numpy as jnp
+
+    np.testing.assert_array_equal(np.asarray(ent(jnp.ones((4,)))),
+                                  np.full(4, 3.0, "float32"))
+    # memory hit on the NEXT lookup — disk is only the process boundary
+    runtime.compile_cached(key, _trivial_compiled)
+    assert runtime.EXEC_CACHE_STATS["hits"] == 1
+
+
+def test_exec_cache_corrupt_blob_recompiles(exec_disk):
+    """A damaged blob must cost one recompile, never a crash; the bad
+    file is dropped and replaced by the fresh executable."""
+    _needs_serialization()
+    key = ("triv", 3.0)
+    runtime.compile_cached(key, _trivial_compiled)
+    blob = [f for f in os.listdir(exec_disk) if f.endswith(".jexec")][0]
+    with open(os.path.join(exec_disk, blob), "wb") as f:
+        f.write(b"not an executable")
+    runtime.exec_cache_clear()
+    runtime.compile_cached(key, _trivial_compiled)
+    assert runtime.EXEC_CACHE_STATS["compiles"] == 1
+    assert runtime.EXEC_CACHE_STATS["disk_misses"] == 1
+    assert runtime.EXEC_CACHE_STATS["disk_hits"] == 0
+    # the recompile re-persisted a good blob
+    runtime.exec_cache_clear()
+    runtime.compile_cached(key, _trivial_compiled)
+    assert runtime.EXEC_CACHE_STATS["disk_hits"] == 1
+
+
+def test_exec_cache_clear_disk(exec_disk):
+    """exec_cache_clear(disk=True) purges the persisted tier AND resets
+    the counters (satellite: the full cold-start reset)."""
+    _needs_serialization()
+    runtime.compile_cached(("a",), _trivial_compiled)
+    runtime.compile_cached(("b",), lambda: _trivial_compiled(5.0))
+    assert len(os.listdir(exec_disk)) == 2
+    runtime.exec_cache_clear(disk=True)
+    assert [f for f in os.listdir(exec_disk)
+            if f.endswith(".jexec")] == []
+    assert runtime.EXEC_CACHE_STATS["compiles"] == 0
+    assert runtime.EXEC_CACHE_STATS["disk_writes"] == 0
+    # cold again: both keys recompile
+    runtime.compile_cached(("a",), _trivial_compiled)
+    assert runtime.EXEC_CACHE_STATS["compiles"] == 1
+
+
+def test_exec_cache_lru_eviction(exec_disk):
+    """The disk tier is size-bounded: oldest-touched blobs evict first."""
+    _needs_serialization()
+    runtime.compile_cached(("a",), _trivial_compiled)
+    blob = os.path.join(exec_disk, os.listdir(exec_disk)[0])
+    one = os.path.getsize(blob)
+    # bound holds ONE blob (plus slack): writing a second evicts the first
+    runtime.exec_cache_configure(exec_disk, max_bytes=int(one * 1.5))
+    os.utime(blob, (1, 1))    # force 'a' to be the LRU entry
+    runtime.compile_cached(("b",), lambda: _trivial_compiled(5.0))
+    assert runtime.EXEC_CACHE_STATS["disk_evictions"] == 1
+    assert len([f for f in os.listdir(exec_disk)
+                if f.endswith(".jexec")]) == 1
+    # 'a' is gone: a fresh process would recompile it, 'b' still loads
+    runtime.exec_cache_clear()
+    runtime.compile_cached(("b",), lambda: _trivial_compiled(5.0))
+    assert runtime.EXEC_CACHE_STATS["disk_hits"] == 1
+    runtime.compile_cached(("a",), _trivial_compiled)
+    assert runtime.EXEC_CACHE_STATS["compiles"] == 1
+
+
+def test_exec_cache_fingerprint_binds_toolchain(exec_disk, monkeypatch):
+    """The digest covers (jax/jaxlib version, device topology): a version
+    bump means the old blob is simply never found — a MISS, not a load
+    of an incompatible executable."""
+    _needs_serialization()
+    key = ("triv", 3.0)
+    runtime.compile_cached(key, _trivial_compiled)
+    runtime.exec_cache_clear()
+    monkeypatch.setattr(runtime, "_exec_cache_fingerprint",
+                        lambda: "jax-from-the-future")
+    runtime.compile_cached(key, _trivial_compiled)
+    assert runtime.EXEC_CACHE_STATS["disk_hits"] == 0
+    assert runtime.EXEC_CACHE_STATS["compiles"] == 1
+
+
+def test_status_records_exec_cache(tmp_workdir, tmp_path):
+    """Every task status JSON carries the exec_cache delta next to
+    stage_counts (empty for tasks that never touch the executor cache)."""
+    import json
+
+    tmp_folder, config_dir = tmp_workdir
+    out = str(tmp_path / "out.n5")
+    task = FillTask(output_path=out, output_key="data", shape=(20, 20, 20),
+                    tmp_folder=tmp_folder, config_dir=config_dir,
+                    max_jobs=2, target="inline")
+    assert build([task])
+    with open(task.output().path) as f:
+        status = json.load(f)
+    assert "exec_cache" in status
+    assert status["exec_cache"] == {}
+
+
+def test_global_config_activates_disk_tier(tmp_path):
+    """Setting ``exec_cache_dir`` in the global config wires the disk
+    tier at task construction — the workflow-level opt-in."""
+    saved = dict(runtime._DISK_TIER)
+    try:
+        d = str(tmp_path / "cfg_cache")
+        config_dir = str(tmp_path / "configs")
+        ConfigDir(config_dir).write_global_config(
+            {"block_shape": [10, 10, 10], "exec_cache_dir": d,
+             "exec_cache_max_bytes": 123456})
+        FillTask(output_path=str(tmp_path / "o.n5"), output_key="d",
+                 shape=(10, 10, 10), tmp_folder=str(tmp_path / "tmp"),
+                 config_dir=config_dir, max_jobs=1, target="inline")
+        assert runtime._exec_cache_dir() == d
+        assert runtime._exec_cache_max_bytes() == 123456
+    finally:
+        runtime._DISK_TIER.update(saved)
