@@ -39,6 +39,14 @@ def default_global_config() -> Dict[str, Any]:
         # activate it); max_bytes None = runtime default (2 GiB LRU)
         "exec_cache_dir": None,
         "exec_cache_max_bytes": None,
+        # observability (core.telemetry): off by default — span recording
+        # costs one attribute read per stage accumulation when disabled.
+        # telemetry_ring_size bounds the in-memory span ring (None =
+        # recorder default, 65536 spans); metrics_path makes each task
+        # status write also drop a Prometheus text-format snapshot there.
+        "telemetry_enabled": False,
+        "telemetry_ring_size": None,
+        "metrics_path": None,
     }
 
 

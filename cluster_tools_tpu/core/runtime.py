@@ -28,11 +28,13 @@ import subprocess
 import sys
 import threading
 import time
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Sequence, Set
 
 from . import config as config_mod
+from . import telemetry
 from .workflow import FileTarget, Task
 
 # ---------------------------------------------------------------------------
@@ -66,14 +68,21 @@ _STAGE_LOCK = threading.Lock()
 #: variance and lumping them made the bench headline a coin flip
 #: (BENCH_r05).  Host-side algorithm stages (union-find scans, table
 #: gathers) use ``host-`` names so they never inflate device_busy_frac.
-_DEVICE_STAGE_PREFIXES = ("sync-", "d2h-", "h2d-", "dispatch", "cap-retry",
-                          "device-")
+#: Canonical definition lives in core.telemetry so span-derived rollups
+#: and this accumulator can never disagree about what counts as device
+#: time.
+_DEVICE_STAGE_PREFIXES = telemetry.DEVICE_STAGE_PREFIXES
 
 
 def stage_add(name: str, seconds: float, count: int = 1) -> None:
     with _STAGE_LOCK:
         _STAGE_ACC[name] = _STAGE_ACC.get(name, 0.0) + float(seconds)
         _COUNT_ACC[name] = _COUNT_ACC.get(name, 0) + int(count)
+    # span emission AFTER (and outside) the accumulator update: the
+    # accumulators — and thus stage_counts in status JSONs — are
+    # bit-for-bit identical whether telemetry is on or off.
+    if telemetry.enabled():
+        telemetry.record_stage(name, seconds, count)
 
 
 def stage_bytes(name: str, nbytes: int) -> None:
@@ -106,6 +115,11 @@ class stage:
     def __exit__(self, *exc):
         stage_add(self.name, time.perf_counter() - self._t0)
         return False
+
+
+#: alias — external docs/issues refer to the stage timer as
+#: ``timed_stage``; it is the same accumulating context manager.
+timed_stage = stage
 
 
 def stages_snapshot() -> Dict[str, float]:
@@ -444,6 +458,35 @@ def exec_cache_delta(before: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def metrics_families():
+    """Runtime-level Prometheus families (process-lifetime counters) for
+    ``telemetry.write_prometheus``: per-stage seconds/entries/bytes from
+    the flat accumulators plus executable-cache activity + hit ratio."""
+    st, cn, by = stages_snapshot(), counts_snapshot(), bytes_snapshot()
+    ec = exec_cache_snapshot()
+    hits = int(ec.get("hits", 0))
+    compiles = int(ec.get("compiles", 0))
+    ratio = hits / (hits + compiles) if (hits + compiles) else 0.0
+    return [
+        ("ctt_stage_seconds_total", "counter",
+         "Accumulated wall seconds per runtime stage",
+         [({"stage": k}, round(v, 6)) for k, v in sorted(st.items())]),
+        ("ctt_stage_entries_total", "counter",
+         "Accumulated entry count per runtime stage",
+         [({"stage": k}, int(v)) for k, v in sorted(cn.items())]),
+        ("ctt_stage_bytes_total", "counter",
+         "Accumulated bytes moved per runtime stage",
+         [({"stage": k}, int(v)) for k, v in sorted(by.items())]),
+        ("ctt_exec_cache_events_total", "counter",
+         "Executable-cache activity by event kind",
+         [({"kind": k}, v) for k, v in sorted(ec.items())
+          if k != "deserialize_s"]),
+        ("ctt_exec_cache_hit_ratio", "gauge",
+         "Executable-cache memory-tier hit ratio (hits/(hits+compiles))",
+         [(None, round(ratio, 6))]),
+    ]
+
+
 def exec_cache_clear(disk: bool = False) -> None:
     """Reset the executable cache AND its counters together (a clear that
     kept stale compile/hit counts would skew the dispatch-model
@@ -571,7 +614,26 @@ class BoundedPool:
             return
         while len(self._pending) >= self.max_inflight:
             self._pending.popleft().result()
+        if telemetry.enabled():
+            fn = self._traced(fn)
         self._pending.append(self._pool.submit(fn, *args, **kwargs))
+
+    @staticmethod
+    def _traced(fn):
+        """Wrap a pool task so the trace shows submit->start queue wait
+        (cat='queue-wait', feeding the queue-wait histogram rollup) and
+        the worker-side execution span (cat='pool')."""
+        submitted = telemetry.now()
+        name = getattr(fn, "__name__", "task")
+
+        def run(*args, **kwargs):
+            started = telemetry.now()
+            telemetry.record("pool-queue-wait", submitted, started,
+                             cat="queue-wait", fn=name)
+            with telemetry.span(f"pool:{name}", cat="pool"):
+                return fn(*args, **kwargs)
+
+        return run
 
     def drain(self) -> None:
         """Wait for every pending task, surfacing the first failure."""
@@ -754,7 +816,11 @@ def _run_job_inline(task_cls, config_path: str, log_fn) -> None:
     with open(config_path) as f:
         job_config = json.load(f)
     job_id = job_config["job_id"]
-    task_cls.process_job(job_id, job_config, log_fn)
+    blocks = job_config.get("block_list")
+    with telemetry.span(f"{job_config.get('task_name', 'job')}:job{job_id}",
+                        cat="job", job_id=job_id,
+                        n_blocks=(None if blocks is None else len(blocks))):
+        task_cls.process_job(job_id, job_config, log_fn)
     log_fn(f"{_JOB_SUCCESS} {job_id}")
 
 
@@ -801,6 +867,9 @@ class BlockTask(Task):
     #: retry attempt counter (class default so run_jobs() works when called
     #: directly, without going through run())
     _retry_count: int = 0
+    #: correlation id linking every attempt span (and the status JSON) of
+    #: one run_jobs invocation across block-granular retries
+    _corr_id: str = ""
 
     def __init__(self, tmp_folder: str, config_dir: str, max_jobs: int = 1,
                  target: str = "local", dependency: Optional[Task] = None,
@@ -827,6 +896,12 @@ class BlockTask(Task):
             exec_cache_configure(
                 self.global_config["exec_cache_dir"],
                 self.global_config.get("exec_cache_max_bytes"))
+        # telemetry is deployment opt-in the same way: the global config
+        # arms the span recorder for every task in the workflow
+        if self.global_config.get("telemetry_enabled"):
+            telemetry.configure(
+                enabled=True,
+                ring_size=self.global_config.get("telemetry_ring_size"))
         os.makedirs(self.tmp_folder, exist_ok=True)
         os.makedirs(os.path.join(self.tmp_folder, "logs"), exist_ok=True)
 
@@ -962,8 +1037,17 @@ class BlockTask(Task):
             self._attempt_bytes = bytes_snapshot()
             self._attempt_counts = counts_snapshot()
             self._attempt_exec = exec_cache_snapshot()
+            # one correlation id per run_jobs invocation: every retry
+            # attempt's span (and the status JSON) carries it, so a
+            # trace viewer can group attempts of the same logical task
+            self._corr_id = uuid.uuid4().hex[:12]
         stages_before = self._attempt_stages
-        executor.run(self, list(range(n_jobs)))
+        with telemetry.span(self.name_with_id, cat="attempt",
+                            correlation_id=self._corr_id,
+                            attempt=self._retry_count, n_jobs=n_jobs,
+                            n_blocks=(None if block_list is None
+                                      else len(block_list))):
+            executor.run(self, list(range(n_jobs)))
         elapsed = time.time() - self._attempt_t0
 
         # -- success detection + block-granular retry ------------------
@@ -1068,9 +1152,14 @@ class BlockTask(Task):
             self._attempt_bytes = bytes_snapshot()
             self._attempt_counts = counts_snapshot()
             self._attempt_exec = exec_cache_snapshot()
+            self._corr_id = uuid.uuid4().hex[:12]
         stages_before = self._attempt_stages
         if my_jobs:
-            executor.run(self, my_jobs)
+            with telemetry.span(self.name_with_id, cat="attempt",
+                                correlation_id=self._corr_id,
+                                attempt=self._retry_count,
+                                n_jobs=len(my_jobs)):
+                executor.run(self, my_jobs)
         # the jobs barrier waits for REAL work (on global tasks, peers sit
         # here for the lead's entire job) — default unbounded, overridable
         # via global config; the verdict/status barriers below are pure
@@ -1193,8 +1282,15 @@ class BlockTask(Task):
             # dispatch is assertable per task, the same way stage_counts
             # made wait counts assertable
             "exec_cache": dict(exec_cache or {}),
+            "correlation_id": self._corr_id,
         }
         config_mod.write_config(self.output().path, status)
+        # optional Prometheus snapshot alongside the status (deployment
+        # opt-in via the global config; the resident server maintains its
+        # own richer metrics.prom)
+        metrics_path = self.global_config.get("metrics_path")
+        if metrics_path:
+            telemetry.write_prometheus(metrics_path, metrics_families())
 
     # -- worker side ----------------------------------------------------
     @classmethod

@@ -45,6 +45,7 @@ import numpy as np
 
 from . import config as config_mod
 from . import runtime
+from . import telemetry
 
 
 class RequestHandle:
@@ -101,6 +102,11 @@ class _Request:
         self.stages: Dict[str, float] = {}
         self.stage_counts: Dict[str, int] = {}
         self.exec_cache: Dict[str, Any] = {}
+        # scheduler gauges snapshotted at submit and re-snapshotted at
+        # claim time (_step): how deep was the backlog when THIS request
+        # got the device, and how many requests each tenant had in flight
+        self.queue_depth: int = 0
+        self.in_flight: Dict[str, int] = {}
 
 
 class FusedROIPipeline:
@@ -364,11 +370,19 @@ class ResidentSegmentationServer:
     """
 
     def __init__(self, workdir: str, pipeline,
-                 name: str = "segmentation_server"):
+                 name: str = "segmentation_server",
+                 metrics_path: Optional[str] = None,
+                 metrics_interval_s: float = 2.0):
         self.workdir = workdir
         self.pipeline = pipeline
         self.name = name
         os.makedirs(workdir, exist_ok=True)
+        # Prometheus snapshot the worker rewrites periodically (and on
+        # every request completion); metrics_path="" disables it
+        self.metrics_path = (os.path.join(workdir, "metrics.prom")
+                             if metrics_path is None else metrics_path)
+        self._metrics_interval = float(metrics_interval_s)
+        self._metrics_last = 0.0
         self._queues: "OrderedDict[str, deque]" = OrderedDict()
         self._rr_next = 0                 # round-robin cursor over tenants
         self._lock = threading.Lock()
@@ -427,6 +441,12 @@ class ResidentSegmentationServer:
             self._thread.join(timeout)
             if not self._thread.is_alive():
                 self._thread = None   # keep the handle if join timed out
+        # final snapshot so a scrape after shutdown sees the drained state
+        if self.metrics_path:
+            try:
+                self.write_metrics()
+            except OSError:
+                pass
 
     def __enter__(self):
         return self.start()
@@ -449,6 +469,7 @@ class ResidentSegmentationServer:
                 raise RuntimeError(f"{self.name} is not accepting "
                                    "requests (shut down?)")
             self._queues.setdefault(tenant, deque()).append(req)
+            req.queue_depth, req.in_flight = self._gauges_locked()
             self._write_status(req)
             self._work.notify_all()
         return RequestHandle(req)
@@ -473,6 +494,37 @@ class ResidentSegmentationServer:
                 "requests": list(self._request_log),
                 "exec_cache": runtime.exec_cache_snapshot(),
             }
+
+    def _gauges_locked(self):
+        """(queue_depth, per-tenant in-flight) — called under the lock.
+        A running request stays in its queue until its terminal pop, so
+        both gauges count queued + in-flight work."""
+        return (sum(len(q) for q in self._queues.values()),
+                {t: len(q) for t, q in self._queues.items() if q})
+
+    def write_metrics(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Prometheus text-format snapshot (server gauges +
+        runtime counters).  Takes the lock for the gauge snapshot — must
+        NOT be called while holding it (see _finish's deadlock note)."""
+        path = path or self.metrics_path
+        if not path:
+            return None
+        with self._lock:
+            depth, inflight = self._gauges_locked()
+            served = dict(self._served)
+        families = [
+            ("ctt_server_queue_depth", "gauge",
+             "Requests queued or in flight across all tenants",
+             [(None, depth)]),
+            ("ctt_server_in_flight", "gauge",
+             "Requests queued or in flight per tenant",
+             [({"tenant": t}, n) for t, n in sorted(inflight.items())]
+             or [(None, 0)]),
+            ("ctt_server_requests_served_total", "counter",
+             "Completed (done or failed) requests per tenant",
+             [({"tenant": t}, n) for t, n in sorted(served.items())]),
+        ] + runtime.metrics_families()
+        return telemetry.write_prometheus(path, families)
 
     # -- scheduler -----------------------------------------------------
     def _pick(self) -> Optional[_Request]:
@@ -507,6 +559,15 @@ class ResidentSegmentationServer:
                     if q and q[0] is req:
                         q.popleft()
                     self._work.notify_all()
+            # periodic metrics rewrite between quanta (outside the lock;
+            # terminal steps also write immediately, see _step)
+            if self.metrics_path and (time.monotonic() - self._metrics_last
+                                      >= self._metrics_interval):
+                self._metrics_last = time.monotonic()
+                try:
+                    self.write_metrics()
+                except OSError:
+                    pass
 
     def _step(self, req: _Request) -> None:
         """One scheduling quantum: a single block of ``req`` (plus the
@@ -521,6 +582,9 @@ class ResidentSegmentationServer:
                 return
             if req.state == "queued":
                 req.state = "running"
+                # claim-time gauge snapshot: the backlog THIS request saw
+                # when it first got the device (satellite: status JSONs)
+                req.queue_depth, req.in_flight = self._gauges_locked()
         st0 = runtime.stages_snapshot()
         cn0 = runtime.counts_snapshot()
         ex0 = runtime.exec_cache_snapshot()
@@ -528,9 +592,14 @@ class ResidentSegmentationServer:
             if req.started_at is None:
                 req.started_at = time.perf_counter()
                 req.ctx = self.pipeline.prepare(req.volume)
+                telemetry.record("queue-wait", req.submitted_at,
+                                 req.started_at, cat="queue-wait",
+                                 tenant=req.tenant, request=req.req_id)
             bid = req.next_block
-            req.block_results.append(
-                self.pipeline.run_block(req.ctx, bid))
+            with telemetry.span(f"block:{bid}", cat="block", block=bid,
+                                tenant=req.tenant, request=req.req_id):
+                req.block_results.append(
+                    self.pipeline.run_block(req.ctx, bid))
             req.next_block += 1
             if req.next_block >= req.n_blocks:
                 req.result = self.pipeline.finalize(req.ctx,
@@ -558,7 +627,22 @@ class ResidentSegmentationServer:
                     self._write_status(req)
                 except OSError:
                     pass
+                # whole-request span (queue-wait -> blocks -> tail) and
+                # an immediate metrics rewrite — both OUTSIDE self._lock
+                # (write_metrics takes it)
+                telemetry.record(f"request:{req.req_id}",
+                                 req.submitted_at,
+                                 req.finished_at or time.perf_counter(),
+                                 cat="request", tenant=req.tenant,
+                                 request=req.req_id, state=req.state,
+                                 n_blocks=req.n_blocks)
                 req.done.set()
+                if self.metrics_path:
+                    self._metrics_last = time.monotonic()
+                    try:
+                        self.write_metrics()
+                    except OSError:
+                        pass
 
     def _finish(self, req: _Request, state: str) -> None:
         """Terminal bookkeeping; the caller (_step) writes the final
@@ -597,6 +681,11 @@ class ResidentSegmentationServer:
             "stage_counts": dict(sorted(req.stage_counts.items(),
                                         key=lambda kv: -kv[1])),
             "exec_cache": dict(req.exec_cache),
+            # scheduler gauges as this request saw them: snapshotted at
+            # submit, re-snapshotted when the worker claimed the request
+            "queue_depth": int(req.queue_depth),
+            "in_flight": {t: int(n) for t, n in
+                          sorted(req.in_flight.items())},
             "error": req.error,
         }
         if req.result is not None:

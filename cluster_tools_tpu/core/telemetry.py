@@ -1,0 +1,509 @@
+"""Structured span tracing + server metrics (L0 observability).
+
+The runtime's three telemetry surfaces before this module — the flat
+``stage_counts`` accumulators (core/runtime.py), ``EXEC_CACHE_STATS``
+deltas, and per-request status JSONs (core/server.py) — answer *how
+much* time each stage took but not *when* it ran, on which thread, or
+where the pipeline bubbles are.  This module adds the missing timeline:
+
+* a thread-safe, **off-by-default** span recorder — every
+  ``runtime.stage(...)`` / ``stage_add(...)`` accumulation also emits a
+  span when enabled (task -> job -> block -> stage hierarchy via a
+  per-thread span stack; monotonic start/end timestamps; thread, tenant
+  and request attributes; bounded ring buffer so an always-on service
+  cannot grow trace state forever);
+* a Chrome trace-event JSON exporter (:func:`export_chrome_trace`) —
+  the output loads directly in Perfetto / chrome://tracing (same event
+  shape as ``jax.profiler``'s trace dumps);
+* span-derived rollups — device-busy seconds/fraction (cross-checkable
+  against the ``device_busy_frac`` accumulator in task status JSONs),
+  pipeline-bubble fraction (the fraction of the trace window where NO
+  device-path stage is active), and queue-wait histograms;
+* a Prometheus-text-format snapshot writer (:func:`write_prometheus`)
+  used by the resident server's ``metrics.prom`` and by the per-task
+  ``metrics_path`` global-config hook.
+
+Design constraints:
+
+* **Telemetry off must be free.**  Every instrumentation site guards on
+  :func:`enabled` (one attribute read); ``bench.py trace`` gates the
+  projected telemetry-off overhead at <1% of the flagship wall, and the
+  tier-1 suite re-checks the per-call bound against the committed
+  TRACE artifact.
+* **``stage_counts`` are bit-for-bit unchanged.**  Spans are emitted
+  AFTER the accumulator update in ``runtime.stage_add`` — the recorder
+  never touches the accumulators, so status JSONs with telemetry off
+  are byte-identical to pre-telemetry builds.
+* **Deterministic export.**  :func:`configure` accepts an injectable
+  clock; the exporter remaps thread ids to dense first-seen integers
+  and pins ``pid`` so a fixed-clock recording exports byte-identical
+  JSON (tested).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Callable, Dict, Iterable, List, NamedTuple, \
+    Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# canonical stage-name registry
+# ---------------------------------------------------------------------------
+
+#: stage-name prefixes attributed to the ACCELERATOR PATH (device compute
+#: + link transfers, which the tunnel serializes).  Shared with
+#: core/runtime.py's ``device_busy_frac`` accounting — ONE definition, so
+#: the span-derived rollups and the accumulator can never disagree about
+#: what counts as device time.
+DEVICE_STAGE_PREFIXES = ("sync-", "d2h-", "h2d-", "dispatch", "cap-retry",
+                         "device-")
+
+#: every stage name the package may pass to ``runtime.stage`` /
+#: ``stage_add`` / ``stage_bytes``.  A typo'd literal would silently open
+#: a new bucket in ``stage_counts`` (and vanish from dashboards keyed on
+#: the canonical names) — tests/test_telemetry.py greps the package for
+#: stage literals and fails on any name missing here.  Extensions
+#: register theirs via :func:`register_stage`.
+STAGE_REGISTRY = {
+    # device path (see DEVICE_STAGE_PREFIXES)
+    "sync-compile",     # one-time XLA builds (AOT lower().compile())
+    "sync-execute",     # steady-state waits on device programs
+    "dispatch",         # program enqueue (async dispatch)
+    "cap-retry",        # capacity-overflow redo through the big program
+    "h2d-upload",       # host -> device volume uploads
+    "d2h-dense", "d2h-edges", "d2h-labels", "d2h-rle",  # device -> host
+    # host path (never counts toward device_busy_frac)
+    "host-decode", "host-fallback", "host-map", "host-reduce",
+    "host-scan", "host-solve",
+    # pool-worker fetches (overlapped with sync-execute; fetch- not d2h-
+    # so the link is not double-counted into device_busy_frac)
+    "fetch-dense", "fetch-rle",
+    # store IO
+    "store-read", "store-write",
+}
+
+
+def register_stage(name: str) -> str:
+    """Register an extension stage name (returns it, for inline use)."""
+    STAGE_REGISTRY.add(name)
+    return name
+
+
+def is_registered(name: str) -> bool:
+    return name in STAGE_REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# span recorder
+# ---------------------------------------------------------------------------
+
+class Span(NamedTuple):
+    sid: int                    # recorder-unique span id
+    parent: Optional[int]       # enclosing span's sid (per-thread stack)
+    name: str
+    cat: str                    # task | job | block | stage | request | ...
+    t0: float                   # recorder-clock seconds (monotonic)
+    t1: float
+    tid: int                    # OS thread ident (remapped at export)
+    tname: str
+    attrs: Dict[str, Any]
+
+
+_DEFAULT_RING = 65536
+
+
+class _Recorder:
+    """Module-global span sink.  ``enabled`` is a plain attribute so the
+    off-path cost at every instrumentation site is one attribute read."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.enabled = False
+        self.clock: Callable[[], float] = time.perf_counter
+        self.spans: deque = deque(maxlen=_DEFAULT_RING)
+        self.dropped = 0
+        self._next_sid = itertools.count(1)
+        self._tls = threading.local()
+
+    def stack(self) -> List[int]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+
+_REC = _Recorder()
+
+
+def enabled() -> bool:
+    return _REC.enabled
+
+
+def now() -> float:
+    """The recorder's clock (injectable via :func:`configure`)."""
+    return _REC.clock()
+
+
+def configure(enabled: Optional[bool] = None,
+              ring_size: Optional[int] = None,
+              clock: Optional[Callable[[], float]] = None) -> None:
+    """Reconfigure the recorder.  ``None`` leaves a setting unchanged.
+    ``ring_size`` rebuilds the ring preserving the newest spans;
+    ``clock`` injects a timestamp source (fixed clocks make export
+    output deterministic for tests)."""
+    with _REC.lock:
+        if ring_size is not None:
+            ring_size = max(int(ring_size), 1)
+            if ring_size != _REC.spans.maxlen:
+                _REC.spans = deque(_REC.spans, maxlen=ring_size)
+        if clock is not None:
+            _REC.clock = clock
+        if enabled is not None:
+            _REC.enabled = bool(enabled)
+
+
+def reset() -> None:
+    """Restore defaults: disabled, empty default-size ring, real clock,
+    span ids from 1.  Tests call this (conftest autouse) so telemetry
+    state never leaks between tests."""
+    with _REC.lock:
+        _REC.enabled = False
+        _REC.clock = time.perf_counter
+        _REC.spans = deque(maxlen=_DEFAULT_RING)
+        _REC.dropped = 0
+        _REC._next_sid = itertools.count(1)
+        _REC._tls = threading.local()
+
+
+def record(name: str, t0: float, t1: float, cat: str = "stage",
+           parent: Optional[int] = None, **attrs) -> Optional[int]:
+    """Record a completed span post-hoc (the hook ``runtime.stage_add``
+    uses — the duration was already measured, so the span costs one ring
+    append).  ``parent`` defaults to the calling thread's innermost open
+    :func:`span`.  No-op (returns None) when disabled."""
+    if not _REC.enabled:
+        return None
+    th = threading.current_thread()
+    if parent is None:
+        stack = _REC.stack()
+        parent = stack[-1] if stack else None
+    with _REC.lock:
+        sid = next(_REC._next_sid)
+        if len(_REC.spans) == _REC.spans.maxlen:
+            _REC.dropped += 1
+        _REC.spans.append(Span(sid, parent, name, cat, float(t0),
+                               float(t1), th.ident or 0, th.name,
+                               dict(attrs)))
+    return sid
+
+
+def record_stage(name: str, seconds: float, count: int = 1
+                 ) -> Optional[int]:
+    """The ``stage_add`` hook: a stage accumulation of ``seconds`` that
+    ended now.  Emits nothing when disabled."""
+    if not _REC.enabled:
+        return None
+    end = _REC.clock()
+    attrs = {"count": int(count)} if count != 1 else {}
+    return record(name, end - float(seconds), end, cat="stage", **attrs)
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    __slots__ = ("name", "cat", "attrs", "sid", "parent", "_t0")
+
+    def __init__(self, name: str, cat: str, attrs: Dict[str, Any]):
+        self.name, self.cat, self.attrs = name, cat, attrs
+
+    def __enter__(self):
+        stack = _REC.stack()
+        self.parent = stack[-1] if stack else None
+        with _REC.lock:
+            self.sid = next(_REC._next_sid)
+        stack.append(self.sid)
+        self._t0 = _REC.clock()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = _REC.clock()
+        stack = _REC.stack()
+        if stack and stack[-1] == self.sid:
+            stack.pop()
+        th = threading.current_thread()
+        with _REC.lock:
+            if len(_REC.spans) == _REC.spans.maxlen:
+                _REC.dropped += 1
+            _REC.spans.append(Span(self.sid, self.parent, self.name,
+                                   self.cat, self._t0, t1, th.ident or 0,
+                                   th.name, self.attrs))
+        return False
+
+
+def span(name: str, cat: str = "stage", **attrs):
+    """Context manager opening a span; children recorded on the same
+    thread (nested ``span``s, ``runtime.stage`` blocks, ``record`` calls)
+    link to it as their parent.  When disabled, returns a shared no-op
+    context — the instrumentation site pays one attribute read."""
+    if not _REC.enabled:
+        return _NULL_SPAN
+    return _SpanCtx(name, cat, attrs)
+
+
+def spans_snapshot() -> List[Span]:
+    with _REC.lock:
+        return list(_REC.spans)
+
+
+def dropped_count() -> int:
+    return _REC.dropped
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export (Perfetto / chrome://tracing)
+# ---------------------------------------------------------------------------
+
+def export_chrome_trace(path: str,
+                        spans: Optional[Sequence[Span]] = None) -> int:
+    """Write the recorded spans as Chrome trace-event JSON (the
+    ``traceEvents`` object format, complete 'X' events with
+    microsecond ``ts``/``dur``) and return the event count.
+
+    Determinism: timestamps are rebased to the earliest span, thread
+    ids are remapped to dense integers in first-recorded order, and
+    ``pid`` is pinned — identical recordings (fixed clock, one thread)
+    export byte-identical files.  Written atomically."""
+    if spans is None:
+        spans = spans_snapshot()
+    spans = sorted(spans, key=lambda s: s.sid)
+    base = min((s.t0 for s in spans), default=0.0)
+    tid_map: Dict[int, int] = {}
+    events: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+        "args": {"name": "cluster_tools_tpu"},
+    }]
+    tnames: Dict[int, str] = {}
+    for s in spans:
+        if s.tid not in tid_map:
+            tid_map[s.tid] = len(tid_map) + 1
+            tnames[tid_map[s.tid]] = s.tname
+    for tid in sorted(tnames):
+        events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                       "tid": tid, "args": {"name": tnames[tid]}})
+    for s in sorted(spans, key=lambda s: (s.t0, s.sid)):
+        args = dict(s.attrs)
+        args["sid"] = s.sid
+        if s.parent is not None:
+            args["parent"] = s.parent
+        events.append({
+            "ph": "X", "name": s.name, "cat": s.cat, "pid": 1,
+            "tid": tid_map[s.tid],
+            "ts": round((s.t0 - base) * 1e6, 3),
+            "dur": round(max(s.t1 - s.t0, 0.0) * 1e6, 3),
+            "args": args,
+        })
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    tmp = path + ".tmp%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(payload, f, sort_keys=True, separators=(",", ":"),
+                  default=str)
+    os.replace(tmp, path)
+    return len(events)
+
+
+# ---------------------------------------------------------------------------
+# span-derived rollups
+# ---------------------------------------------------------------------------
+
+def _merge_intervals(iv: List[Tuple[float, float]]
+                     ) -> List[Tuple[float, float]]:
+    """Union-merge of (start, end) intervals (sorted output)."""
+    out: List[Tuple[float, float]] = []
+    for t0, t1 in sorted(iv):
+        if out and t0 <= out[-1][1]:
+            if t1 > out[-1][1]:
+                out[-1] = (out[-1][0], t1)
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _device_stage_spans(spans: Sequence[Span]) -> List[Span]:
+    return [s for s in spans if s.cat == "stage"
+            and s.name.startswith(DEVICE_STAGE_PREFIXES)]
+
+
+def device_busy_seconds(spans: Optional[Sequence[Span]] = None) -> float:
+    """SUM of device-path stage span durations — the same semantics as
+    the ``device_busy_frac`` accumulator in task status JSONs (sum of
+    device-prefixed stage seconds), so the two cross-check directly."""
+    if spans is None:
+        spans = spans_snapshot()
+    return float(sum(s.t1 - s.t0 for s in _device_stage_spans(spans)))
+
+
+def busy_timeline(spans: Optional[Sequence[Span]] = None,
+                  prefixes: Tuple[str, ...] = DEVICE_STAGE_PREFIXES
+                  ) -> List[Tuple[float, float]]:
+    """Union-merged (start, end) intervals where at least one stage with
+    a matching prefix was active — the device-busy timeline.  (On this
+    stack the tunnel serializes the accelerator path, so one merged
+    timeline IS the per-device view; callers with true multi-stream
+    traces can filter spans by a ``device`` attr before merging.)"""
+    if spans is None:
+        spans = spans_snapshot()
+    return _merge_intervals(
+        [(s.t0, s.t1) for s in spans if s.cat == "stage"
+         and s.name.startswith(prefixes)])
+
+
+def device_busy_fraction(wall: Optional[float] = None,
+                         spans: Optional[Sequence[Span]] = None
+                         ) -> Optional[float]:
+    """Device-busy seconds / wall (clamped to 1.0, like the accumulator).
+    ``wall`` defaults to the trace window (earliest t0 to latest t1)."""
+    if spans is None:
+        spans = spans_snapshot()
+    if wall is None:
+        wall = trace_window(spans)
+    if not wall:
+        return None
+    return min(device_busy_seconds(spans) / wall, 1.0)
+
+
+def pipeline_bubble_fraction(spans: Optional[Sequence[Span]] = None,
+                             wall: Optional[float] = None
+                             ) -> Optional[float]:
+    """Fraction of the trace window where NO device-path stage was
+    active — the pipeline-bubble metric ROADMAP item 1 steers on.  Uses
+    the union-merged timeline (overlapping stages don't double-count)."""
+    if spans is None:
+        spans = spans_snapshot()
+    if wall is None:
+        wall = trace_window(spans)
+    if not wall:
+        return None
+    covered = sum(t1 - t0 for t0, t1 in busy_timeline(spans))
+    return max(1.0 - covered / wall, 0.0)
+
+
+def trace_window(spans: Optional[Sequence[Span]] = None) -> float:
+    if spans is None:
+        spans = spans_snapshot()
+    if not spans:
+        return 0.0
+    return max(s.t1 for s in spans) - min(s.t0 for s in spans)
+
+
+_DEFAULT_WAIT_BINS = (0.001, 0.01, 0.1, 1.0, 10.0)
+
+
+def queue_wait_histogram(bins: Sequence[float] = _DEFAULT_WAIT_BINS,
+                         spans: Optional[Sequence[Span]] = None
+                         ) -> Dict[str, Any]:
+    """Prometheus-style cumulative histogram over ``cat='queue-wait'``
+    span durations (BoundedPool submit->start waits, server request
+    queue waits): ``{"buckets": {"0.01": n, ..., "+Inf": n}, "count",
+    "sum"}``."""
+    if spans is None:
+        spans = spans_snapshot()
+    waits = [s.t1 - s.t0 for s in spans if s.cat == "queue-wait"]
+    buckets = {}
+    for b in bins:
+        buckets[repr(float(b))] = sum(1 for w in waits if w <= b)
+    buckets["+Inf"] = len(waits)
+    return {"buckets": buckets, "count": len(waits),
+            "sum": round(float(sum(waits)), 6)}
+
+
+def summary(wall: Optional[float] = None) -> Dict[str, Any]:
+    """One-call rollup of the recorded trace: span counts by category,
+    per-stage second sums, device-busy (sum AND merged-timeline views),
+    bubble fraction, queue-wait histogram, ring drops.  ``wall`` (e.g.
+    the measured workflow wall) scopes the busy fraction; defaults to
+    the trace window."""
+    spans = spans_snapshot()
+    window = trace_window(spans)
+    if wall is None:
+        wall = window
+    stage_seconds: Dict[str, float] = {}
+    stage_entries: Dict[str, int] = {}
+    for s in spans:
+        if s.cat != "stage":
+            continue
+        stage_seconds[s.name] = stage_seconds.get(s.name, 0.0) \
+            + (s.t1 - s.t0)
+        stage_entries[s.name] = stage_entries.get(s.name, 0) \
+            + int(s.attrs.get("count", 1))
+    busy = device_busy_seconds(spans)
+    merged = sum(t1 - t0 for t0, t1 in busy_timeline(spans))
+    return {
+        "n_spans": len(spans),
+        "dropped": dropped_count(),
+        "by_cat": dict(Counter(s.cat for s in spans)),
+        "window_s": round(window, 4),
+        "wall_s": round(wall, 4) if wall else None,
+        "stage_seconds": {k: round(v, 4) for k, v in sorted(
+            stage_seconds.items(), key=lambda kv: -kv[1])},
+        "stage_entries": dict(sorted(stage_entries.items(),
+                                     key=lambda kv: -kv[1])),
+        "device_busy_s": round(busy, 4),
+        "device_busy_timeline_s": round(merged, 4),
+        "device_busy_frac": (round(min(busy / wall, 1.0), 4)
+                             if wall else None),
+        "pipeline_bubble_frac": (round(max(1.0 - merged / wall, 0.0), 4)
+                                 if wall else None),
+        "queue_wait": queue_wait_histogram(spans=spans),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format snapshot writer
+# ---------------------------------------------------------------------------
+
+def _prom_escape(v: Any) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def write_prometheus(path: str,
+                     families: Iterable[Tuple[str, str, str,
+                                              Iterable[Tuple[
+                                                  Optional[Dict[str, Any]],
+                                                  Any]]]]) -> str:
+    """Write a Prometheus text-format (exposition format 0.0.4) snapshot
+    atomically.  ``families`` is an iterable of
+    ``(name, type, help_text, samples)`` with ``samples`` an iterable of
+    ``(labels_dict_or_None, value)``.  Returns ``path``."""
+    lines: List[str] = []
+    for name, mtype, help_text, samples in families:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            lab = ""
+            if labels:
+                lab = "{" + ",".join(
+                    f'{k}="{_prom_escape(v)}"'
+                    for k, v in sorted(labels.items())) + "}"
+            lines.append(f"{name}{lab} {value}")
+    tmp = path + ".tmp%d" % os.getpid()
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+    return path

@@ -896,6 +896,7 @@ class FusedSegmentationBlocks(BlockTask):
         face-assembly and final-write tasks never re-read the store."""
         import jax.numpy as jnp
 
+        from ..core import telemetry
         from ..core.runtime import (stage, stage_add, stage_bytes,
                                     stream_window, writer_pool)
         from ..ops.sweep import rle_decode_packed
@@ -1033,6 +1034,15 @@ class FusedSegmentationBlocks(BlockTask):
                       feats_np)
 
         def drain(entry, retried: bool = False):
+            # one block span per drained block (the cap-retry redo stays
+            # inside the original block's span, under its cap-retry stage)
+            if retried or not telemetry.enabled():
+                return _drain_body(entry, retried)
+            with telemetry.span(f"block:{entry[0]}", cat="block",
+                                block=entry[0]):
+                return _drain_body(entry, retried)
+
+        def _drain_body(entry, retried: bool = False):
             bid, handles = entry
             tbl_d, plo_d, phi_d, dense16_d, dense_d = handles
             with stage("sync-execute"):
@@ -1054,7 +1064,7 @@ class FusedSegmentationBlocks(BlockTask):
                         coarse_factor=prog_args[-1])
                     handles = big(vol_dev,
                                   _origin_extent(blocking.get_block(bid)))
-                    return drain((bid, handles), retried=True)
+                    return _drain_body((bid, handles), retried=True)
             if cap_over > 0:
                 raise RuntimeError(
                     f"block {bid}: pair compaction overflow persists at "
@@ -1168,6 +1178,7 @@ class FusedSegmentationBlocks(BlockTask):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..core import runtime as rt
+        from ..core import telemetry
         from ..core.runtime import (stage, stage_add, stage_bytes,
                                     writer_pool)
         from .watershed import _normalize_input, reflect_indices
@@ -1303,38 +1314,43 @@ class FusedSegmentationBlocks(BlockTask):
             stage_add("store-write", time.perf_counter() - t0)
             stage_bytes("store-write", arr.nbytes)
 
+        def _drain_slab(sid, pool):
+            block = blocking.get_block(sid)
+            off, k_i = int(offs[sid]), int(ks[sid])
+            sl = lab[block.bb]
+            local = np.where(sl > 0, sl.astype("int64") - off, 0)
+            local = local.astype("uint16" if k_i < 65536
+                                 else "uint32")
+            _FRAGMENT_CACHE[ws_cache_key + (sid,)] = (local, off,
+                                                      block.bb)
+            pool.submit(_write, block.bb, sl.astype("uint64"))
+            n_r = int(meta[sid, 1])
+            uv_np = uv_all[sid, :n_r].astype("uint64")
+            feats_np = feats_all[sid, :n_r]
+            order = np.lexsort((uv_np[:, 1], uv_np[:, 0]))
+            uv_np, feats_np = uv_np[order], feats_np[order]
+            np.savez(_staged_path(tmp_folder, sid), uv=uv_np,
+                     feats=feats_np, k=np.int64(k_i),
+                     offset=np.uint64(off))
+            # the shard tables are already COMPLETE sub-graphs (the
+            # device added the cross-shard faces): save them now —
+            # there is no FusedFaceAssembly pass on this path
+            nodes = np.arange(off + 1, off + k_i + 1, dtype="uint64")
+            if len(uv_np):
+                nodes = np.unique(np.concatenate([nodes,
+                                                  uv_np.ravel()]))
+            g.save_sub_graph(cfg["problem_path"], 0, sid, nodes,
+                             uv_np)
+            np.savez(_staged_path(tmp_folder, sid) + ".full.npz",
+                     uv=uv_np, feats=feats_np)
+            max_ids[sid] = k_i
+            log_fn(f"processed block {sid}")
+
         with writer_pool(cfg, ds_out) as pool:
             for sid in range(blocking.n_blocks):
-                block = blocking.get_block(sid)
-                off, k_i = int(offs[sid]), int(ks[sid])
-                sl = lab[block.bb]
-                local = np.where(sl > 0, sl.astype("int64") - off, 0)
-                local = local.astype("uint16" if k_i < 65536
-                                     else "uint32")
-                _FRAGMENT_CACHE[ws_cache_key + (sid,)] = (local, off,
-                                                          block.bb)
-                pool.submit(_write, block.bb, sl.astype("uint64"))
-                n_r = int(meta[sid, 1])
-                uv_np = uv_all[sid, :n_r].astype("uint64")
-                feats_np = feats_all[sid, :n_r]
-                order = np.lexsort((uv_np[:, 1], uv_np[:, 0]))
-                uv_np, feats_np = uv_np[order], feats_np[order]
-                np.savez(_staged_path(tmp_folder, sid), uv=uv_np,
-                         feats=feats_np, k=np.int64(k_i),
-                         offset=np.uint64(off))
-                # the shard tables are already COMPLETE sub-graphs (the
-                # device added the cross-shard faces): save them now —
-                # there is no FusedFaceAssembly pass on this path
-                nodes = np.arange(off + 1, off + k_i + 1, dtype="uint64")
-                if len(uv_np):
-                    nodes = np.unique(np.concatenate([nodes,
-                                                      uv_np.ravel()]))
-                g.save_sub_graph(cfg["problem_path"], 0, sid, nodes,
-                                 uv_np)
-                np.savez(_staged_path(tmp_folder, sid) + ".full.npz",
-                         uv=uv_np, feats=feats_np)
-                max_ids[sid] = k_i
-                log_fn(f"processed block {sid}")
+                with telemetry.span(f"slab:{sid}", cat="block",
+                                    block=sid):
+                    _drain_slab(sid, pool)
         state["offset"] = np.uint64(offs[-1])
 
     @classmethod

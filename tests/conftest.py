@@ -64,6 +64,18 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    """Telemetry state is module-global (like the stage accumulators,
+    which tests consume via deltas): restore disabled / default ring /
+    real clock after every test so a test that arms the recorder cannot
+    leak spans into the next one."""
+    yield
+    from cluster_tools_tpu.core import telemetry
+
+    telemetry.reset()
+
+
 @pytest.fixture()
 def tmp_workdir(tmp_path):
     """tmp_folder + config_dir pair with a small-block global config."""

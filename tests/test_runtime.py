@@ -230,6 +230,50 @@ def test_bounded_pool_inline_and_threaded():
     assert sorted(results) == list(range(20))
 
 
+def test_stage_accumulator_thread_safety():
+    """Regression (ISSUE 15 satellite): the global stage accumulator
+    must hold up under 8 concurrent BoundedPool-style writers.  Unit
+    additions (1.0 / 1 / one byte) make the expected totals EXACT — a
+    lost read-modify-write shows up as a missing integer, not float
+    noise."""
+    import threading
+
+    from cluster_tools_tpu.core.runtime import (BoundedPool, stage,
+                                                stage_add, stage_bytes)
+
+    n_threads, n_iter = 8, 500
+    st0 = runtime.stages_snapshot()
+    cn0 = runtime.counts_snapshot()
+    by0 = runtime.bytes_snapshot()
+    barrier = threading.Barrier(n_threads)
+
+    def hammer():
+        barrier.wait()      # maximize interleaving
+        for _ in range(n_iter):
+            stage_add("host-map", 1.0)
+            stage_bytes("host-map", 1)
+            with stage("host-scan"):
+                pass
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * n_iter
+    assert runtime.stages_delta(st0)["host-map"] == float(total)
+    cn = runtime.counts_delta(cn0)
+    assert cn["host-map"] == total and cn["host-scan"] == total
+    assert runtime.bytes_delta(by0)["host-map"] == float(total)
+
+    # same guarantee through the pool the drains actually use
+    cn1 = runtime.counts_snapshot()
+    with BoundedPool(n_threads) as pool:
+        for _ in range(total):
+            pool.submit(stage_add, "host-reduce", 1.0)
+    assert runtime.counts_delta(cn1)["host-reduce"] == total
+
+
 def test_bounded_pool_surfaces_worker_errors():
     from cluster_tools_tpu.core.runtime import BoundedPool
 

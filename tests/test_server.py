@@ -204,6 +204,84 @@ def test_concurrent_submitters(tmp_path):
     assert sum(srv.stats()["tenants_served"].values()) == 20
 
 
+def test_status_gauges_queue_depth_and_in_flight(tmp_path):
+    """ISSUE 15 satellite: every request status JSON carries the
+    scheduler gauges — total queue_depth and per-tenant in_flight —
+    snapshotted at claim time, present and non-negative."""
+    pipe = StubPipeline(n_blocks=2)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe)
+    handles = [srv.submit("alice", "A1"), srv.submit("alice", "A2"),
+               srv.submit("bob", "B1")]
+    srv.start()
+    srv.shutdown(drain=True)
+    statuses = []
+    for h in handles:
+        with open(h.status_path) as f:
+            statuses.append(json.load(f))
+    for status in statuses:
+        assert isinstance(status["queue_depth"], int)
+        assert status["queue_depth"] >= 1       # itself, at minimum
+        assert isinstance(status["in_flight"], dict)
+        assert all(isinstance(n, int) and n >= 0
+                   for n in status["in_flight"].values())
+        # consistency: the per-tenant gauges decompose the total
+        assert sum(status["in_flight"].values()) == status["queue_depth"]
+    # the first claimed request saw the whole pre-start backlog
+    assert statuses[0]["queue_depth"] == 3
+    assert statuses[0]["in_flight"] == {"alice": 2, "bob": 1}
+
+
+def test_server_writes_metrics_prom(tmp_path):
+    """The worker maintains a Prometheus text snapshot (metrics.prom):
+    queue depth + per-tenant gauges + served counters + exec-cache hit
+    ratio, in valid exposition format."""
+    pipe = StubPipeline(n_blocks=2)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe)
+    assert srv.metrics_path == str(tmp_path / "metrics.prom")
+    srv.submit("alice", "A")
+    srv.submit("bob", "B")
+    srv.start()
+    srv.shutdown(drain=True)
+    assert srv.metrics_path is not None
+    with open(srv.metrics_path) as f:
+        text = f.read()
+    assert "# TYPE ctt_server_queue_depth gauge" in text
+    assert "# HELP ctt_server_queue_depth" in text
+    assert "ctt_server_queue_depth 0" in text    # drained
+    assert 'ctt_server_requests_served_total{tenant="alice"} 1' in text
+    assert 'ctt_server_requests_served_total{tenant="bob"} 1' in text
+    assert "# TYPE ctt_exec_cache_hit_ratio gauge" in text
+
+
+def test_server_request_spans(tmp_path):
+    """With telemetry armed, each request leaves a queue-wait span, one
+    block span per block (tenant/request attributed), and a whole-
+    request span — the queue-wait -> blocks -> tail timeline."""
+    from cluster_tools_tpu.core import telemetry
+
+    telemetry.configure(enabled=True)
+    pipe = StubPipeline(n_blocks=3)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe)
+    h = srv.submit("alice", "A")
+    srv.start()
+    srv.shutdown(drain=True)
+    assert h.done()
+    spans = telemetry.spans_snapshot()
+    reqs = [s for s in spans if s.cat == "request"]
+    waits = [s for s in spans if s.cat == "queue-wait"]
+    blocks = [s for s in spans if s.cat == "block"]
+    assert len(reqs) == 1 and reqs[0].attrs["state"] == "done"
+    assert reqs[0].attrs["tenant"] == "alice"
+    assert len(waits) == 1
+    assert len(blocks) == 3
+    assert [s.attrs["block"] for s in blocks] == [0, 1, 2]
+    assert all(s.attrs["request"] == h.request_id for s in blocks)
+    # the request span covers its queue wait and every block
+    assert reqs[0].t0 <= waits[0].t0
+    assert all(reqs[0].t0 <= s.t0 and s.t1 <= reqs[0].t1
+               for s in blocks)
+
+
 @pytest.mark.slow
 def test_real_pipeline_multi_tenant(tmp_path):
     """End-to-end on the REAL fused ROI pipeline (one shared tiny
