@@ -1047,12 +1047,194 @@ def main_trace():
         "detail": os.path.basename(path)}))
 
 
+# ---------------------------------------------------------------------------
+# `serve` config: open-loop load harness against the resident server
+# (ISSUE 16 tentpole 1).  Three stub-pipeline load levels (light / near
+# saturation / overload) run THREADED — the real worker thread, real
+# sleeps — so the committed BENCH_serve.json measures the serve path's
+# actual queueing behaviour, plus one real-pipeline row (FusedROIPipeline
+# at a small ROI geometry; XLA compile paid at startup via
+# ensure_compiled, warm requests after).  Every row embeds the SLO
+# engine's burn-rate report.
+#
+# `python bench.py serve --smoke` is the tier-1 path: the SAME schema,
+# produced by the deterministic virtual-time mode, no XLA, no real
+# sleeps — the smoke test asserts the schema without paying the load run.
+# ---------------------------------------------------------------------------
+
+# (offered_hz, n_requests) stub levels: the synthetic cost model
+# (2 ms prepare + 4 ms/block + 1 ms tail, mean 3.4 blocks/request) puts
+# capacity near 60 req/s — the ladder brackets it from both sides
+SERVE_STUB_LEVELS = ((20.0, 200), (55.0, 300), (120.0, 300))
+SERVE_SEED = 7
+
+
+def _serve_spec(rate_hz, n_requests, smoke=False):
+    from cluster_tools_tpu.core.loadgen import LoadSpec
+    if smoke:
+        # tiny but same shape: enough requests that every lane appears
+        return LoadSpec(seed=SERVE_SEED, rate_hz=rate_hz,
+                        n_requests=max(30, n_requests // 10),
+                        n_tenants=20)
+    return LoadSpec(seed=SERVE_SEED, rate_hz=rate_hz,
+                    n_requests=n_requests, n_tenants=200)
+
+
+def _serve_stub_row(rate_hz, n_requests, base, smoke):
+    from cluster_tools_tpu.core import loadgen, slo
+    spec = _serve_spec(rate_hz, n_requests, smoke)
+    wd = os.path.join(base, f"stub_{int(rate_hz)}hz")
+    eng = slo.SLOEngine()
+    if smoke:
+        row = loadgen.run_virtual(spec, wd, slo_engine=eng)
+        row.pop("server", None)
+        row.pop("schedule", None)
+    else:
+        row = loadgen.run_threaded(spec, wd, slo_engine=eng,
+                                   metrics_path=None)
+    row["pipeline"] = "synthetic"
+    return row
+
+
+def _serve_real_row(base):
+    """One `slow` real-pipeline row: FusedROIPipeline at a small ROI
+    geometry, low offered rate (the compile is paid before the clock
+    starts)."""
+    import jax  # noqa: F401  — fail fast if the device stack is absent
+
+    from cluster_tools_tpu.core import loadgen, slo
+    from cluster_tools_tpu.core.server import FusedROIPipeline
+
+    shape = (16, 64, 64)
+    pipe = FusedROIPipeline(shape, block_shape=(8, 32, 32),
+                            halo=(2, 8, 8))
+    pipe.ensure_compiled("uint8")
+    rng = np.random.default_rng(SERVE_SEED)
+
+    def volume_fn(arrival):
+        # seeded per-request volumes at the server's ROI geometry
+        return rng.integers(0, 256, size=shape, dtype=np.uint8)
+
+    spec = loadgen.LoadSpec(seed=SERVE_SEED, rate_hz=2.0, n_requests=12,
+                            n_tenants=4)
+    eng = slo.SLOEngine()
+    row = loadgen.run_threaded(spec, os.path.join(base, "real"),
+                               pipeline=pipe, slo_engine=eng,
+                               volume_fn=volume_fn, metrics_path=None)
+    row["pipeline"] = "fused_roi"
+    row["roi_shape"] = list(shape)
+    return row
+
+
+def main_serve():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    smoke = "--smoke" in sys.argv[1:]
+    out_path = None
+    argv = sys.argv[1:]
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    base = "/tmp/ctt_bench_serve"
+    shutil.rmtree(base, ignore_errors=True)
+    os.makedirs(base)
+
+    rows = [_serve_stub_row(r, n, base, smoke)
+            for r, n in SERVE_STUB_LEVELS]
+    real_row = None
+    if not smoke:
+        real_row = _serve_real_row(base)
+
+    from cluster_tools_tpu.core import slo
+    out = {
+        "metric": "serve_load",
+        "mode": "smoke-virtual" if smoke else "threaded",
+        "seed": SERVE_SEED,
+        "note": ("open-loop Poisson load against the resident server: "
+                 "latency charged from SCHEDULED arrival, so overload "
+                 "compounds into the tail.  Stub levels bracket the "
+                 "synthetic capacity (~60 req/s); the real-pipeline row "
+                 "is warm (compile paid before the clock).  Single-core "
+                 "emulated-mesh caveat applies: absolute latencies are "
+                 "host-bound, the CURVES (saturation shape, lane "
+                 "separation, burn rates) are the signal"),
+        "slo_objectives": [o._asdict() for o in slo.default_objectives()],
+        "burn_windows": [list(w) for w in slo.DEFAULT_WINDOWS],
+        "stub_levels": rows,
+        "real_pipeline": real_row,
+    }
+    if out_path is None and not smoke:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out_path = os.path.join(here, "BENCH_serve.json")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps({
+        "metric": out["metric"], "mode": out["mode"],
+        "levels": [{"offered_hz": r["offered_hz"],
+                    "throughput_hz": r["throughput_hz"],
+                    "p99_edit_s": r["lanes"].get("edit", {}).get("p99_s"),
+                    "overload": r.get("slo", {}).get("overload")}
+                   for r in rows],
+        "real": (None if real_row is None else {
+            "throughput_hz": real_row["throughput_hz"],
+            "served": real_row["served"]}),
+        "detail": (os.path.basename(out_path) if out_path else None)}))
+
+
+# ---------------------------------------------------------------------------
+# `trace-diff` config: the regression gate (ISSUE 16 tentpole 3).
+# Compares two committed trace artifacts' rollups per stage and exits
+# nonzero when a device-path quantity regresses past threshold — the
+# before/after check every future perf PR runs against TRACE_r07.json
+# (ROADMAP item 5's entry point).
+# ---------------------------------------------------------------------------
+
+def main_trace_diff(argv):
+    import argparse
+
+    from cluster_tools_tpu.core import telemetry
+
+    p = argparse.ArgumentParser(
+        prog="bench.py trace-diff",
+        description="Gate on rollup regressions between two trace "
+                    "artifacts (baseline vs candidate)")
+    p.add_argument("baseline", help="baseline artifact (e.g. "
+                                    "TRACE_r07.json) or bare rollups")
+    p.add_argument("candidate", help="candidate artifact or bare rollups")
+    p.add_argument("--rel-threshold", type=float, default=0.2,
+                   help="relative worsening that regresses (default 0.2)")
+    p.add_argument("--abs-floor-s", type=float, default=0.05,
+                   help="absolute floor in seconds under which deltas "
+                        "never regress (default 0.05)")
+    p.add_argument("--bubble-abs", type=float, default=0.05,
+                   help="absolute pipeline-bubble-fraction worsening "
+                        "that regresses (default 0.05)")
+    args = p.parse_args(argv)
+
+    def load_rollups(path):
+        with open(path) as f:
+            doc = json.load(f)
+        # accept a full TRACE artifact or a bare rollups dict
+        return doc.get("rollups", doc) if isinstance(doc, dict) else doc
+
+    diff = telemetry.diff_rollups(
+        load_rollups(args.baseline), load_rollups(args.candidate),
+        rel_threshold=args.rel_threshold, abs_floor_s=args.abs_floor_s,
+        bubble_abs=args.bubble_abs)
+    print(json.dumps(diff, indent=1))
+    sys.exit(1 if diff["regressed"] else 0)
+
+
 if __name__ == "__main__":
     if os.environ.get("BENCH_MESH") or "mesh" in sys.argv[1:]:
         main_mesh()
     elif os.environ.get("BENCH_WARM") or "warm" in sys.argv[1:]:
         main_warm()
+    elif "trace-diff" in sys.argv[1:]:
+        main_trace_diff(
+            [a for a in sys.argv[1:] if a != "trace-diff"])
     elif os.environ.get("BENCH_TRACE") or "trace" in sys.argv[1:]:
         main_trace()
+    elif os.environ.get("BENCH_SERVE") or "serve" in sys.argv[1:]:
+        main_serve()
     else:
         main()

@@ -47,6 +47,10 @@ def default_global_config() -> Dict[str, Any]:
         "telemetry_enabled": False,
         "telemetry_ring_size": None,
         "metrics_path": None,
+        # serve-path SLOs (core.slo): list of {"name", "lane",
+        # "latency_s", "target"} objective dicts for the resident
+        # server's SLO engine; None = slo.default_objectives()
+        "slo_objectives": None,
     }
 
 

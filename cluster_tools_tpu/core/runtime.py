@@ -1042,11 +1042,17 @@ class BlockTask(Task):
             # trace viewer can group attempts of the same logical task
             self._corr_id = uuid.uuid4().hex[:12]
         stages_before = self._attempt_stages
-        with telemetry.span(self.name_with_id, cat="attempt",
-                            correlation_id=self._corr_id,
-                            attempt=self._retry_count, n_jobs=n_jobs,
-                            n_blocks=(None if block_list is None
-                                      else len(block_list))):
+        # correlation scope: every span recorded inside the attempt
+        # (worker-thread pool spans included — the stack is deliberately
+        # process-global, see telemetry._Recorder) inherits this
+        # attempt's 12-hex id in its Chrome-trace args, so a histogram
+        # outlier joins back to its Perfetto spans
+        with telemetry.correlation(self._corr_id), \
+                telemetry.span(self.name_with_id, cat="attempt",
+                               correlation_id=self._corr_id,
+                               attempt=self._retry_count, n_jobs=n_jobs,
+                               n_blocks=(None if block_list is None
+                                         else len(block_list))):
             executor.run(self, list(range(n_jobs)))
         elapsed = time.time() - self._attempt_t0
 
@@ -1155,10 +1161,11 @@ class BlockTask(Task):
             self._corr_id = uuid.uuid4().hex[:12]
         stages_before = self._attempt_stages
         if my_jobs:
-            with telemetry.span(self.name_with_id, cat="attempt",
-                                correlation_id=self._corr_id,
-                                attempt=self._retry_count,
-                                n_jobs=len(my_jobs)):
+            with telemetry.correlation(self._corr_id), \
+                    telemetry.span(self.name_with_id, cat="attempt",
+                                   correlation_id=self._corr_id,
+                                   attempt=self._retry_count,
+                                   n_jobs=len(my_jobs)):
                 executor.run(self, my_jobs)
         # the jobs barrier waits for REAL work (on global tasks, peers sit
         # here for the lead's entire job) — default unbounded, overridable

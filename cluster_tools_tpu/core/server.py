@@ -48,6 +48,13 @@ from . import runtime
 from . import telemetry
 
 
+class AdmissionRejected(RuntimeError):
+    """Raised by ``submit`` when the admission-control hook declines a
+    request (the overload gate ROADMAP item 3's scheduler work aims
+    at).  A rejection is bookkept (``ctt_server_admission_rejected_total``)
+    but never reaches the queue."""
+
+
 class RequestHandle:
     """Caller-side view of a submitted request."""
 
@@ -82,9 +89,10 @@ class RequestHandle:
 
 class _Request:
     def __init__(self, req_id: str, tenant: str, volume, params: Dict,
-                 n_blocks: int, status_path: str):
+                 n_blocks: int, status_path: str, lane: str = "bulk"):
         self.req_id = req_id
         self.tenant = tenant
+        self.lane = lane
         self.volume = volume
         self.params = dict(params)
         self.status_path = status_path
@@ -372,10 +380,28 @@ class ResidentSegmentationServer:
     def __init__(self, workdir: str, pipeline,
                  name: str = "segmentation_server",
                  metrics_path: Optional[str] = None,
-                 metrics_interval_s: float = 2.0):
+                 metrics_interval_s: float = 2.0,
+                 clock=time.perf_counter,
+                 slo=None,
+                 admission_hook=None,
+                 latency_buckets=telemetry.DEFAULT_LATENCY_BUCKETS,
+                 occupancy_samples: int = 4096):
         self.workdir = workdir
         self.pipeline = pipeline
         self.name = name
+        # request-lifecycle clock: injectable so the load harness's
+        # deterministic virtual-time mode can drive generator, server
+        # and SLO engine from ONE clock (latencies become exact)
+        self._clock = clock
+        # optional slo.SLOEngine: fed every terminal request, source of
+        # the overload gauge and the admission-control decision input
+        self.slo = slo
+        # admission hook point: callable(tenant, lane, overloaded) ->
+        # bool; False rejects the submit with AdmissionRejected.  None
+        # accepts everything (today's default — the hook is where
+        # ROADMAP item 3's scheduler work plugs in)
+        self.admission_hook = admission_hook
+        self._latency_buckets = tuple(latency_buckets)
         os.makedirs(workdir, exist_ok=True)
         # Prometheus snapshot the worker rewrites periodically (and on
         # every request completion); metrics_path="" disables it
@@ -396,6 +422,15 @@ class ResidentSegmentationServer:
         # bounded: an always-on service must not grow per-request state
         # forever (stats() reports the RECENT window + total counts)
         self._request_log: deque = deque(maxlen=1000)
+        # latency distributions (cumulative-bucket histograms): request
+        # latency and queue wait per lane, request latency per tenant
+        self._lat_hist: Dict[str, telemetry.Histogram] = {}
+        self._wait_hist: Dict[str, telemetry.Histogram] = {}
+        self._tenant_hist: Dict[str, telemetry.Histogram] = {}
+        self._rejected: Dict[str, int] = {}
+        # occupancy timeline: gauge samples at enqueue, claim AND
+        # completion — no blind spots between claims (satellite fix)
+        self._occupancy: deque = deque(maxlen=int(occupancy_samples))
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "ResidentSegmentationServer":
@@ -436,6 +471,7 @@ class ResidentSegmentationServer:
                             keep.append(req)
                     q.clear()
                     q.extend(keep)
+                self._occupancy_sample_locked("cancel")
             self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
@@ -456,20 +492,40 @@ class ResidentSegmentationServer:
         return False
 
     # -- client API ----------------------------------------------------
-    def submit(self, tenant: str, volume: np.ndarray,
+    def submit(self, tenant: str, volume: np.ndarray, lane: str = "bulk",
+               arrival_t: Optional[float] = None,
                **params) -> RequestHandle:
+        """Enqueue one request.  ``lane`` tags the request's priority
+        class for the latency histograms and SLO objectives;
+        ``arrival_t`` lets an open-loop load generator charge latency
+        from the SCHEDULED arrival instant rather than the submit call
+        (under overload the two diverge, and open-loop semantics demand
+        the former)."""
+        if self.admission_hook is not None and \
+                not self.admission_hook(tenant, lane, self.overloaded()):
+            with self._lock:
+                self._rejected[lane] = self._rejected.get(lane, 0) + 1
+            raise AdmissionRejected(
+                f"request from {tenant} (lane={lane}) rejected by "
+                "admission hook")
         req_id = f"{tenant}_{next(self._seq)}"
+        n_blocks = (self.pipeline.request_n_blocks(volume)
+                    if hasattr(self.pipeline, "request_n_blocks")
+                    else self.pipeline.n_blocks)
         req = _Request(
             req_id, tenant, volume, params,
-            n_blocks=self.pipeline.n_blocks,
+            n_blocks=n_blocks, lane=lane,
             status_path=os.path.join(self.workdir,
                                      f"request_{req_id}.status"))
+        req.submitted_at = (self._clock() if arrival_t is None
+                            else float(arrival_t))
         with self._lock:
             if not self._accepting:
                 raise RuntimeError(f"{self.name} is not accepting "
                                    "requests (shut down?)")
             self._queues.setdefault(tenant, deque()).append(req)
             req.queue_depth, req.in_flight = self._gauges_locked()
+            self._occupancy_sample_locked("enqueue")
             self._write_status(req)
             self._work.notify_all()
         return RequestHandle(req)
@@ -485,7 +541,32 @@ class ResidentSegmentationServer:
                 if left is not None and left <= 0:
                     return False
                 self._work.wait(left)
+        # drained: flush the throttled metrics snapshot so a scrape right
+        # after a drain never sees a stale backlog (outside the lock —
+        # write_metrics takes it)
+        if self.metrics_path:
+            try:
+                self.write_metrics()
+            except OSError:
+                pass
         return True
+
+    def overloaded(self) -> bool:
+        """The SLO engine's multi-window overload verdict (False when no
+        engine is attached) — the admission hook's third argument."""
+        return bool(self.slo is not None and self.slo.overload())
+
+    def occupancy_timeline(self) -> List[Dict[str, Any]]:
+        """Recent (bounded) gauge samples taken at enqueue, claim and
+        completion — the serve path's occupancy-over-time record."""
+        with self._lock:
+            return list(self._occupancy)
+
+    def _occupancy_sample_locked(self, event: str) -> None:
+        depth, inflight = self._gauges_locked()
+        self._occupancy.append({
+            "t": round(self._clock(), 6), "event": event,
+            "queue_depth": depth, "tenants": len(inflight)})
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -494,6 +575,16 @@ class ResidentSegmentationServer:
                 "requests": list(self._request_log),
                 "exec_cache": runtime.exec_cache_snapshot(),
             }
+
+    def latency_histograms(self):
+        """Copies of the live distributions: ``(request latency by lane,
+        queue wait by lane, request latency by tenant)`` — the load
+        harness reads percentiles (and the determinism test bucket
+        counts) from these."""
+        with self._lock:
+            return ({l: h.copy() for l, h in self._lat_hist.items()},
+                    {l: h.copy() for l, h in self._wait_hist.items()},
+                    {t: h.copy() for t, h in self._tenant_hist.items()})
 
     def _gauges_locked(self):
         """(queue_depth, per-tenant in-flight) — called under the lock.
@@ -509,9 +600,18 @@ class ResidentSegmentationServer:
         path = path or self.metrics_path
         if not path:
             return None
+        # SLO evaluation BEFORE taking our lock (the engine has its own)
+        rep = self.slo.report() if self.slo is not None else None
         with self._lock:
             depth, inflight = self._gauges_locked()
             served = dict(self._served)
+            rejected = dict(self._rejected)
+            lat = [({"lane": l}, h.copy()) for l, h in
+                   sorted(self._lat_hist.items())]
+            wait = [({"lane": l}, h.copy()) for l, h in
+                    sorted(self._wait_hist.items())]
+            ten = [({"tenant": t}, h.copy()) for t, h in
+                   sorted(self._tenant_hist.items())]
         families = [
             ("ctt_server_queue_depth", "gauge",
              "Requests queued or in flight across all tenants",
@@ -523,7 +623,34 @@ class ResidentSegmentationServer:
             ("ctt_server_requests_served_total", "counter",
              "Completed (done or failed) requests per tenant",
              [({"tenant": t}, n) for t, n in sorted(served.items())]),
-        ] + runtime.metrics_families()
+            ("ctt_server_overload", "gauge",
+             "1 when any SLO objective breaches on every burn-rate "
+             "window",
+             [(None, int(bool(rep["overload"])) if rep is not None
+               else 0)]),
+            ("ctt_server_admission_rejected_total", "counter",
+             "Requests declined by the admission hook, per lane",
+             [({"lane": l}, n) for l, n in sorted(rejected.items())]
+             or [(None, 0)]),
+        ]
+        if lat:
+            families.append(telemetry.histogram_family(
+                "ctt_server_request_latency_seconds",
+                "Request latency (submit/arrival to terminal) per lane",
+                lat))
+        if wait:
+            families.append(telemetry.histogram_family(
+                "ctt_server_queue_wait_seconds",
+                "Queue wait (submit/arrival to first quantum) per lane",
+                wait))
+        if ten:
+            families.append(telemetry.histogram_family(
+                "ctt_server_tenant_latency_seconds",
+                "Request latency per tenant", ten))
+        if self.slo is not None:
+            families += self.slo.metrics_families(rep)
+        families += runtime.metrics_families()
+        families += telemetry.metrics_families()
         return telemetry.write_prometheus(path, families)
 
     # -- scheduler -----------------------------------------------------
@@ -543,6 +670,42 @@ class ResidentSegmentationServer:
                 return q[0]
         return None
 
+    def _retire(self, req: _Request) -> None:
+        """Pop a finished request from its queue (terminal pop) and wake
+        waiters.  No-op while the request still has blocks left."""
+        with self._lock:
+            if req.done.is_set() or req.error is not None:
+                q = self._queues.get(req.tenant)
+                if q and q[0] is req:
+                    q.popleft()
+                # completion sample AFTER the terminal pop: the timeline
+                # shows the backlog the NEXT pick will see
+                self._occupancy_sample_locked(
+                    "done" if req.state == "done" else "failed")
+                self._work.notify_all()
+
+    def step_once(self) -> bool:
+        """Run ONE scheduling quantum on the calling thread (no worker).
+
+        The deterministic spine of the load harness's virtual-time mode:
+        with an injected clock and a synchronous pipeline, driving the
+        server exclusively through ``step_once`` makes every latency —
+        hence every histogram bucket count — an exact function of the
+        seed.  Returns False when no request is runnable.  Mutually
+        exclusive with ``start()``: refusing to mix modes is what keeps
+        the quantum single-threaded."""
+        if self._thread is not None:
+            raise RuntimeError(
+                f"{self.name}: step_once() cannot run while the worker "
+                "thread owns the device (started server)")
+        with self._lock:
+            req = self._pick()
+        if req is None:
+            return False
+        self._step(req)
+        self._retire(req)
+        return True
+
     def _serve_loop(self) -> None:
         while True:
             with self._lock:
@@ -553,12 +716,7 @@ class ResidentSegmentationServer:
                     self._work.wait()
                     req = self._pick()
             self._step(req)
-            with self._lock:
-                if req.done.is_set() or req.error is not None:
-                    q = self._queues.get(req.tenant)
-                    if q and q[0] is req:
-                        q.popleft()
-                    self._work.notify_all()
+            self._retire(req)
             # periodic metrics rewrite between quanta (outside the lock;
             # terminal steps also write immediately, see _step)
             if self.metrics_path and (time.monotonic() - self._metrics_last
@@ -585,16 +743,18 @@ class ResidentSegmentationServer:
                 # claim-time gauge snapshot: the backlog THIS request saw
                 # when it first got the device (satellite: status JSONs)
                 req.queue_depth, req.in_flight = self._gauges_locked()
+                self._occupancy_sample_locked("claim")
         st0 = runtime.stages_snapshot()
         cn0 = runtime.counts_snapshot()
         ex0 = runtime.exec_cache_snapshot()
         try:
             if req.started_at is None:
-                req.started_at = time.perf_counter()
+                req.started_at = self._clock()
                 req.ctx = self.pipeline.prepare(req.volume)
                 telemetry.record("queue-wait", req.submitted_at,
                                  req.started_at, cat="queue-wait",
-                                 tenant=req.tenant, request=req.req_id)
+                                 tenant=req.tenant, request=req.req_id,
+                                 lane=req.lane)
             bid = req.next_block
             with telemetry.span(f"block:{bid}", cat="block", block=bid,
                                 tenant=req.tenant, request=req.req_id):
@@ -632,10 +792,11 @@ class ResidentSegmentationServer:
                 # (write_metrics takes it)
                 telemetry.record(f"request:{req.req_id}",
                                  req.submitted_at,
-                                 req.finished_at or time.perf_counter(),
+                                 req.finished_at if req.finished_at
+                                 is not None else self._clock(),
                                  cat="request", tenant=req.tenant,
                                  request=req.req_id, state=req.state,
-                                 n_blocks=req.n_blocks)
+                                 n_blocks=req.n_blocks, lane=req.lane)
                 req.done.set()
                 if self.metrics_path:
                     self._metrics_last = time.monotonic()
@@ -648,34 +809,51 @@ class ResidentSegmentationServer:
         """Terminal bookkeeping; the caller (_step) writes the final
         status and THEN sets the done event."""
         req.state = state
-        req.finished_at = time.perf_counter()
+        req.finished_at = self._clock()
         req.ctx = None                    # free the device volume
         req.volume = None
         req.block_results = []
+        lat = req.finished_at - req.submitted_at
+        # explicit None check: a virtual clock legitimately starts at 0.0
+        wait = ((req.started_at if req.started_at is not None
+                 else req.finished_at) - req.submitted_at)
         with self._lock:
             self._served[req.tenant] = self._served.get(req.tenant, 0) + 1
             self._request_log.append({
                 "request_id": req.req_id, "tenant": req.tenant,
-                "state": state,
-                "latency_s": round(req.finished_at - req.submitted_at, 4),
-                "queue_wait_s": round(
-                    (req.started_at or req.finished_at)
-                    - req.submitted_at, 4),
+                "lane": req.lane, "state": state,
+                "latency_s": round(lat, 4),
+                "queue_wait_s": round(wait, 4),
             })
+            self._lat_hist.setdefault(
+                req.lane,
+                telemetry.Histogram(self._latency_buckets)).observe(lat)
+            self._wait_hist.setdefault(
+                req.lane,
+                telemetry.Histogram(self._latency_buckets)).observe(wait)
+            self._tenant_hist.setdefault(
+                req.tenant,
+                telemetry.Histogram(self._latency_buckets)).observe(lat)
+        # feed the SLO engine OUTSIDE our lock (it has its own)
+        if self.slo is not None:
+            self.slo.record(req.lane, lat, ok=(state == "done"))
 
     def _write_status(self, req: _Request) -> None:
-        now = time.perf_counter()
+        now = self._clock()
         status = {
             "request": req.req_id,
             "tenant": req.tenant,
+            "lane": req.lane,
             "state": req.state,
             "n_blocks": req.n_blocks,
             "blocks_done": req.next_block,
             "queue_wait_s": round(
-                (req.started_at - req.submitted_at) if req.started_at
+                (req.started_at - req.submitted_at)
+                if req.started_at is not None
                 else (now - req.submitted_at), 4),
             "wall_time": round(
-                ((req.finished_at or now) - req.submitted_at), 4),
+                ((req.finished_at if req.finished_at is not None
+                  else now) - req.submitted_at), 4),
             "stages": {k: round(v, 4) for k, v in sorted(
                 req.stages.items(), key=lambda kv: -kv[1])},
             "stage_counts": dict(sorted(req.stage_counts.items(),

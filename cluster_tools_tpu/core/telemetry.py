@@ -42,14 +42,16 @@ Design constraints:
 
 from __future__ import annotations
 
+import bisect
 import itertools
 import json
 import os
+import re
 import threading
 import time
 from collections import Counter, deque
 from typing import Any, Callable, Dict, Iterable, List, NamedTuple, \
-    Optional, Sequence, Tuple
+    Optional, Sequence, Tuple, Union
 
 # ---------------------------------------------------------------------------
 # canonical stage-name registry
@@ -98,6 +100,41 @@ def is_registered(name: str) -> bool:
     return name in STAGE_REGISTRY
 
 
+#: every Prometheus metric FAMILY name the package may emit through
+#: :func:`write_prometheus` (the same discipline as STAGE_REGISTRY: a
+#: typo'd family would silently open a new time series and vanish from
+#: dashboards keyed on the canonical names).  tests/test_telemetry.py
+#: greps the package for ``ctt_*`` literals and fails on any name
+#: missing here.
+METRIC_REGISTRY = {
+    # runtime counters (core/runtime.py metrics_families)
+    "ctt_stage_seconds_total", "ctt_stage_entries_total",
+    "ctt_stage_bytes_total", "ctt_exec_cache_events_total",
+    "ctt_exec_cache_hit_ratio",
+    # server gauges/counters/histograms (core/server.py write_metrics)
+    "ctt_server_queue_depth", "ctt_server_in_flight",
+    "ctt_server_requests_served_total",
+    "ctt_server_request_latency_seconds",
+    "ctt_server_queue_wait_seconds",
+    "ctt_server_tenant_latency_seconds",
+    "ctt_server_overload", "ctt_server_admission_rejected_total",
+    # SLO engine (core/slo.py via server metrics)
+    "ctt_slo_burn_rate", "ctt_slo_compliance",
+    # telemetry self-metrics (metrics_families below)
+    "ctt_telemetry_dropped_spans_total", "ctt_telemetry_ring_spans",
+}
+
+
+def register_metric(name: str) -> str:
+    """Register an extension metric family name (returns it)."""
+    METRIC_REGISTRY.add(name)
+    return name
+
+
+def is_registered_metric(name: str) -> bool:
+    return name in METRIC_REGISTRY
+
+
 # ---------------------------------------------------------------------------
 # span recorder
 # ---------------------------------------------------------------------------
@@ -129,6 +166,11 @@ class _Recorder:
         self.dropped = 0
         self._next_sid = itertools.count(1)
         self._tls = threading.local()
+        # correlation-id stack (module-global, NOT thread-local, on
+        # purpose: run_jobs attempts serialize, and executor WORKER
+        # threads spawned inside an attempt must inherit its id — that
+        # is exactly the join key the exemplar-style linking needs)
+        self.corr: List[str] = []
 
     def stack(self) -> List[int]:
         st = getattr(self._tls, "stack", None)
@@ -178,6 +220,43 @@ def reset() -> None:
         _REC.dropped = 0
         _REC._next_sid = itertools.count(1)
         _REC._tls = threading.local()
+        _REC.corr = []
+
+
+class _CorrCtx:
+    __slots__ = ("cid",)
+
+    def __init__(self, cid: str):
+        self.cid = cid
+
+    def __enter__(self):
+        _REC.corr.append(self.cid)
+        return self
+
+    def __exit__(self, *exc):
+        if _REC.corr and _REC.corr[-1] == self.cid:
+            _REC.corr.pop()
+        return False
+
+
+def correlation(corr_id: str) -> _CorrCtx:
+    """Scope a correlation id: every span recorded inside (on ANY
+    thread — attempts serialize, so the global stack is safe) carries it
+    as a ``corr`` attr, which the Chrome-trace exporter emits into the
+    event ``args``.  That is the join key that links histogram outliers
+    (status JSONs carry the same 12-hex retry correlation id) back to
+    their Perfetto spans."""
+    return _CorrCtx(str(corr_id))
+
+
+def current_correlation() -> Optional[str]:
+    return _REC.corr[-1] if _REC.corr else None
+
+
+def _attach_corr(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    if _REC.corr and "corr" not in attrs:
+        attrs["corr"] = _REC.corr[-1]
+    return attrs
 
 
 def record(name: str, t0: float, t1: float, cat: str = "stage",
@@ -198,7 +277,7 @@ def record(name: str, t0: float, t1: float, cat: str = "stage",
             _REC.dropped += 1
         _REC.spans.append(Span(sid, parent, name, cat, float(t0),
                                float(t1), th.ident or 0, th.name,
-                               dict(attrs)))
+                               _attach_corr(dict(attrs))))
     return sid
 
 
@@ -252,7 +331,7 @@ class _SpanCtx:
                 _REC.dropped += 1
             _REC.spans.append(Span(self.sid, self.parent, self.name,
                                    self.cat, self._t0, t1, th.ident or 0,
-                                   th.name, self.attrs))
+                                   th.name, _attach_corr(self.attrs)))
         return False
 
 
@@ -474,6 +553,187 @@ def summary(wall: Optional[float] = None) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
+# cumulative-bucket histogram (Prometheus semantics)
+# ---------------------------------------------------------------------------
+
+#: default request-latency bucket bounds (seconds) — the classic
+#: Prometheus latency ladder, wide enough to cover a 2 ms stub quantum
+#: and a 30 s cold compile in the same histogram.
+DEFAULT_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                           1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _le_str(bound: float) -> str:
+    return "+Inf" if bound == float("inf") else repr(float(bound))
+
+
+class Histogram:
+    """Prometheus-correct cumulative-bucket histogram.
+
+    An observation ``v`` lands in the FIRST bucket with ``v <= le``;
+    exported ``_bucket`` samples are cumulative, the mandatory
+    ``le="+Inf"`` bucket equals ``_count``, and ``_sum`` carries the
+    exact sum — the invariants tests/test_telemetry.py's promtool-style
+    lint enforces on every emitted snapshot.  Not internally locked:
+    owners (the server) serialize observations under their own lock."""
+
+    __slots__ = ("bounds", "bucket_counts", "sum", "count")
+
+    def __init__(self, bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        bs = tuple(sorted(float(b) for b in bounds))
+        if not bs or len(set(bs)) != len(bs):
+            raise ValueError(f"bad histogram bounds {bounds}")
+        self.bounds = bs
+        self.bucket_counts = [0] * (len(bs) + 1)   # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.bucket_counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def cumulative(self) -> Dict[str, int]:
+        """``{le_str: cumulative_count, ..., "+Inf": count}`` — the
+        deterministic assertion target for the load-harness tier-1."""
+        out: Dict[str, int] = {}
+        cum = 0
+        for i, b in enumerate(self.bounds):
+            cum += self.bucket_counts[i]
+            out[_le_str(b)] = cum
+        out["+Inf"] = self.count
+        return out
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile (the ``histogram_quantile``
+        estimate): linear within the bucket, clamped to the highest
+        finite bound when the rank falls in the +Inf bucket."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        cum = 0
+        for i, b in enumerate(self.bounds):
+            prev = cum
+            cum += self.bucket_counts[i]
+            if cum >= target:
+                lo = self.bounds[i - 1] if i else 0.0
+                inside = self.bucket_counts[i]
+                frac = (target - prev) / inside if inside else 1.0
+                return lo + (b - lo) * frac
+        return self.bounds[-1]
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        if other.bounds != self.bounds:
+            raise ValueError("histogram bounds mismatch")
+        for i, c in enumerate(other.bucket_counts):
+            self.bucket_counts[i] += c
+        self.sum += other.sum
+        self.count += other.count
+        return self
+
+    def copy(self) -> "Histogram":
+        h = Histogram(self.bounds)
+        h.bucket_counts = list(self.bucket_counts)
+        h.sum, h.count = self.sum, self.count
+        return h
+
+    def to_samples(self, labels: Optional[Dict[str, Any]] = None
+                   ) -> List[Tuple[str, Dict[str, Any], Any]]:
+        """Suffixed samples for :func:`write_prometheus`:
+        ``name_bucket{le=...}`` (cumulative, ``+Inf`` last), ``name_sum``,
+        ``name_count``."""
+        base = dict(labels or {})
+        out: List[Tuple[str, Dict[str, Any], Any]] = []
+        cum = 0
+        for i, b in enumerate(self.bounds):
+            cum += self.bucket_counts[i]
+            out.append(("_bucket", {**base, "le": _le_str(b)}, cum))
+        out.append(("_bucket", {**base, "le": "+Inf"}, self.count))
+        out.append(("_sum", dict(base), round(self.sum, 9)))
+        out.append(("_count", dict(base), self.count))
+        return out
+
+
+def histogram_family(name: str, help_text: str,
+                     items: Iterable[Tuple[Optional[Dict[str, Any]],
+                                           "Histogram"]]):
+    """A ``(name, "histogram", help, samples)`` family for
+    :func:`write_prometheus` from labelled :class:`Histogram`\\ s."""
+    samples: List[Tuple[str, Dict[str, Any], Any]] = []
+    for labels, hist in items:
+        samples.extend(hist.to_samples(labels))
+    return (name, "histogram", help_text, samples)
+
+
+# ---------------------------------------------------------------------------
+# trace-diff regression gate (rollup-vs-rollup comparison)
+# ---------------------------------------------------------------------------
+
+def diff_rollups(a: Dict[str, Any], b: Dict[str, Any], *,
+                 rel_threshold: float = 0.2, abs_floor_s: float = 0.05,
+                 bubble_abs: float = 0.05) -> Dict[str, Any]:
+    """Compare two span rollups (``summary()`` dicts, or the ``rollups``
+    section of a TRACE artifact): per-stage seconds, total device-busy
+    seconds, and the pipeline-bubble fraction.
+
+    A quantity REGRESSES when the candidate ``b`` exceeds the baseline
+    ``a`` by more than ``max(abs_floor_s, rel_threshold * a)`` (the abs
+    floor keeps microsecond stages from tripping the relative gate on
+    noise).  Device-path stages and the device-busy total GATE (they are
+    what ROADMAP item 5 steers on); host/store stage regressions are
+    reported as warnings only, because host time is the thing device
+    optimizations deliberately trade against.  ``bench.py trace-diff``
+    exits nonzero iff ``regressed``."""
+    sa = a.get("stage_seconds") or {}
+    sb = b.get("stage_seconds") or {}
+    stages: Dict[str, Dict[str, Any]] = {}
+    regressions: List[str] = []
+    warnings: List[str] = []
+    for name in sorted(set(sa) | set(sb)):
+        av, bv = float(sa.get(name, 0.0)), float(sb.get(name, 0.0))
+        delta = bv - av
+        worse = delta > max(abs_floor_s, rel_threshold * av)
+        device = name.startswith(DEVICE_STAGE_PREFIXES)
+        stages[name] = {
+            "a_s": round(av, 4), "b_s": round(bv, 4),
+            "delta_s": round(delta, 4),
+            "rel": (round(delta / av, 4) if av > 0 else None),
+            "device": device, "regressed": worse,
+        }
+        if worse:
+            (regressions if device else warnings).append(f"stage:{name}")
+    busy_a = float(a.get("device_busy_s", 0.0))
+    busy_b = float(b.get("device_busy_s", 0.0))
+    busy_delta = busy_b - busy_a
+    busy_worse = busy_delta > max(abs_floor_s, rel_threshold * busy_a)
+    if busy_worse:
+        regressions.append("device_busy_s")
+    bub_a = a.get("pipeline_bubble_frac")
+    bub_b = b.get("pipeline_bubble_frac")
+    bub_delta = (None if bub_a is None or bub_b is None
+                 else float(bub_b) - float(bub_a))
+    bub_worse = bub_delta is not None and bub_delta > bubble_abs
+    if bub_worse:
+        regressions.append("pipeline_bubble_frac")
+    return {
+        "thresholds": {"rel": rel_threshold, "abs_floor_s": abs_floor_s,
+                       "bubble_abs": bubble_abs},
+        "stages": stages,
+        "device_busy": {"a_s": round(busy_a, 4), "b_s": round(busy_b, 4),
+                        "delta_s": round(busy_delta, 4),
+                        "regressed": busy_worse},
+        "bubble": {"a": bub_a, "b": bub_b,
+                   "delta": (round(bub_delta, 4)
+                             if bub_delta is not None else None),
+                   "regressed": bub_worse},
+        "regressions": regressions,
+        "warnings": warnings,
+        "regressed": bool(regressions),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Prometheus text-format snapshot writer
 # ---------------------------------------------------------------------------
 
@@ -484,26 +744,238 @@ def _prom_escape(v: Any) -> str:
 
 def write_prometheus(path: str,
                      families: Iterable[Tuple[str, str, str,
-                                              Iterable[Tuple[
-                                                  Optional[Dict[str, Any]],
-                                                  Any]]]]) -> str:
+                                              Iterable[Union[
+                                                  Tuple[Optional[
+                                                      Dict[str, Any]], Any],
+                                                  Tuple[str, Dict[str, Any],
+                                                        Any]]]]]) -> str:
     """Write a Prometheus text-format (exposition format 0.0.4) snapshot
     atomically.  ``families`` is an iterable of
     ``(name, type, help_text, samples)`` with ``samples`` an iterable of
-    ``(labels_dict_or_None, value)``.  Returns ``path``."""
+    ``(labels_dict_or_None, value)`` or, for histogram/summary families,
+    ``(name_suffix, labels_dict, value)`` (see
+    :meth:`Histogram.to_samples`).  Returns ``path``."""
     lines: List[str] = []
     for name, mtype, help_text, samples in families:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
-        for labels, value in samples:
+        for sample in samples:
+            if len(sample) == 3:
+                suffix, labels, value = sample
+            else:
+                (labels, value), suffix = sample, ""
             lab = ""
             if labels:
                 lab = "{" + ",".join(
                     f'{k}="{_prom_escape(v)}"'
                     for k, v in sorted(labels.items())) + "}"
-            lines.append(f"{name}{lab} {value}")
+            lines.append(f"{name}{suffix}{lab} {value}")
     tmp = path + ".tmp%d" % os.getpid()
     with open(tmp, "w") as f:
         f.write("\n".join(lines) + "\n")
     os.replace(tmp, path)
     return path
+
+
+def metrics_families():
+    """Telemetry self-metrics for :func:`write_prometheus` — most
+    importantly the ring's dropped-span count, which was invisible
+    before (a saturated ring silently truncates every rollup derived
+    from it)."""
+    with _REC.lock:
+        n_spans = len(_REC.spans)
+        dropped = _REC.dropped
+    return [
+        ("ctt_telemetry_dropped_spans_total", "counter",
+         "Spans evicted from the bounded telemetry ring",
+         [(None, dropped)]),
+        ("ctt_telemetry_ring_spans", "gauge",
+         "Spans currently held in the telemetry ring",
+         [(None, n_spans)]),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format lint (pure-python promtool subset)
+# ---------------------------------------------------------------------------
+
+_PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_PROM_LABEL_KEY_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_PROM_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)(?:\s+(-?\d+))?$")
+_PROM_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_prom_labels(blob: str, lineno: int, errors: List[str]
+                       ) -> Optional[Dict[str, str]]:
+    """Parse a ``{k="v",...}`` label blob honoring the three legal
+    escapes (``\\\\``, ``\\"``, ``\\n``); reports malformed syntax."""
+    inner = blob[1:-1]
+    labels: Dict[str, str] = {}
+    i, n = 0, len(inner)
+    while i < n:
+        m = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', inner[i:])
+        if not m:
+            errors.append(f"line {lineno}: malformed label pair at "
+                          f"{inner[i:i + 20]!r}")
+            return None
+        key = m.group(1)
+        i += m.end()
+        chars: List[str] = []
+        closed = False
+        while i < n:
+            c = inner[i]
+            if c == "\\":
+                nxt = inner[i + 1] if i + 1 < n else ""
+                if nxt in ("\\", '"', "n"):
+                    chars.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                    i += 2
+                else:
+                    errors.append(
+                        f"line {lineno}: bad escape \\{nxt} in label "
+                        f"{key}")
+                    i += 2
+            elif c == '"':
+                closed = True
+                i += 1
+                break
+            else:
+                chars.append(c)
+                i += 1
+        if not closed:
+            errors.append(f"line {lineno}: unterminated label value for "
+                          f"{key}")
+            return None
+        if key in labels:
+            errors.append(f"line {lineno}: duplicate label {key}")
+        labels[key] = "".join(chars)
+        if i < n and inner[i] == ",":
+            i += 1
+    return labels
+
+
+def lint_prometheus(text: str) -> List[str]:
+    """Promtool-style lint of an exposition-format snapshot.  Returns a
+    list of error strings (empty = clean).  Checks: metric/label name
+    syntax, label-value escaping, HELP/TYPE present before samples,
+    duplicate series, float-parseable values, and the histogram
+    invariants — cumulative bucket monotonicity, the mandatory
+    ``le="+Inf"`` bucket equal to ``_count``, and ``_sum``/``_count``
+    presence."""
+    errors: List[str] = []
+    typed: Dict[str, str] = {}
+    seen_series: set = set()
+    # (family, frozen_labels_minus_le) -> [(le_float, count, lineno)]
+    hist_buckets: Dict[Tuple[str, frozenset], List[Tuple[float, float]]] = {}
+    hist_counts: Dict[Tuple[str, frozenset], float] = {}
+    hist_sums: Dict[Tuple[str, frozenset], float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            if len(parts) < 4 and parts[1] == "TYPE":
+                errors.append(f"line {lineno}: malformed TYPE line")
+                continue
+            name = parts[2]
+            if not _PROM_NAME_RE.match(name):
+                errors.append(f"line {lineno}: bad metric name {name!r}")
+            if parts[1] == "TYPE":
+                mtype = parts[3].strip() if len(parts) > 3 else ""
+                if mtype not in _PROM_TYPES:
+                    errors.append(
+                        f"line {lineno}: unknown TYPE {mtype!r} for "
+                        f"{name}")
+                if name in typed:
+                    errors.append(f"line {lineno}: duplicate TYPE for "
+                                  f"{name}")
+                typed[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        m = _PROM_SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {lineno}: unparseable sample "
+                          f"{line[:60]!r}")
+            continue
+        name, blob, value = m.group(1), m.group(2), m.group(3)
+        labels = (_parse_prom_labels(blob, lineno, errors)
+                  if blob else {})
+        if labels is None:
+            continue
+        for k in labels:
+            if not _PROM_LABEL_KEY_RE.match(k):
+                errors.append(f"line {lineno}: bad label name {k!r}")
+        try:
+            val = float(value)
+        except ValueError:
+            errors.append(f"line {lineno}: non-numeric value {value!r}")
+            continue
+        # resolve the family: histogram samples carry suffixed names
+        family, suffix = name, ""
+        if name not in typed:
+            for suf in _HIST_SUFFIXES:
+                base = name[:-len(suf)] if name.endswith(suf) else None
+                if base and typed.get(base) in ("histogram", "summary"):
+                    family, suffix = base, suf
+                    break
+        if family not in typed:
+            errors.append(f"line {lineno}: sample {name} has no "
+                          f"preceding # TYPE")
+            continue
+        key = (name, frozenset(labels.items()))
+        if key in seen_series:
+            errors.append(f"line {lineno}: duplicate series {name}"
+                          f"{sorted(labels.items())}")
+        seen_series.add(key)
+        if typed.get(family) == "histogram":
+            hkey = (family, frozenset((k, v) for k, v in labels.items()
+                                      if k != "le"))
+            if suffix == "_bucket":
+                if "le" not in labels:
+                    errors.append(f"line {lineno}: histogram bucket "
+                                  f"without le label")
+                    continue
+                le_raw = labels["le"]
+                try:
+                    le = (float("inf") if le_raw == "+Inf"
+                          else float(le_raw))
+                except ValueError:
+                    errors.append(f"line {lineno}: bad le value "
+                                  f"{le_raw!r}")
+                    continue
+                hist_buckets.setdefault(hkey, []).append((le, val))
+            elif suffix == "_count":
+                hist_counts[hkey] = val
+            elif suffix == "_sum":
+                hist_sums[hkey] = val
+            elif family == name:
+                errors.append(f"line {lineno}: bare sample {name} in "
+                              f"histogram family")
+    for hkey, buckets in hist_buckets.items():
+        family, labels = hkey[0], dict(hkey[1])
+        where = f"{family}{sorted(labels.items())}"
+        in_order = sorted(buckets)
+        counts = [c for _, c in in_order]
+        if counts != sorted(counts):
+            errors.append(f"{where}: bucket counts not monotone "
+                          f"non-decreasing in le order: {counts}")
+        if not in_order or in_order[-1][0] != float("inf"):
+            errors.append(f"{where}: missing le=\"+Inf\" bucket")
+        else:
+            inf_count = in_order[-1][1]
+            if hkey not in hist_counts:
+                errors.append(f"{where}: missing _count sample")
+            elif hist_counts[hkey] != inf_count:
+                errors.append(
+                    f"{where}: _count {hist_counts[hkey]} != +Inf "
+                    f"bucket {inf_count}")
+        if hkey not in hist_sums:
+            errors.append(f"{where}: missing _sum sample")
+    for hkey in set(hist_counts) | set(hist_sums):
+        if hkey not in hist_buckets:
+            family, labels = hkey[0], dict(hkey[1])
+            errors.append(f"{family}{sorted(labels.items())}: _sum/"
+                          f"_count without any _bucket samples")
+    return errors

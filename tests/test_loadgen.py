@@ -1,0 +1,194 @@
+"""Open-loop load harness: schedule determinism, virtual-time replay
+(identical histogram bucket counts per seed — the ISSUE 16 acceptance
+bar), threaded open-loop smoke, and the `bench.py serve --smoke` schema.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.core import loadgen, slo
+from cluster_tools_tpu.core.server import AdmissionRejected
+
+SPEC = loadgen.LoadSpec(seed=11, rate_hz=150.0, n_requests=200,
+                        n_tenants=120)
+
+
+# ---------------------------------------------------------------------------
+# schedule generation
+# ---------------------------------------------------------------------------
+
+def test_schedule_deterministic_per_seed():
+    a = loadgen.generate_schedule(SPEC)
+    b = loadgen.generate_schedule(SPEC)
+    assert a == b
+    c = loadgen.generate_schedule(SPEC._replace(seed=12))
+    assert a != c
+
+
+def test_schedule_open_loop_properties():
+    sched = loadgen.generate_schedule(SPEC)
+    assert len(sched) == SPEC.n_requests
+    # arrivals are sorted (open loop: the schedule is fixed up front)
+    ts = [a.t for a in sched]
+    assert ts == sorted(ts)
+    # mean inter-arrival ~ 1/rate (Poisson, loose 3x bound)
+    mean_gap = ts[-1] / len(ts)
+    assert 1 / (3 * SPEC.rate_hz) < mean_gap < 3 / SPEC.rate_hz
+    # the mix shows up: both lanes, all ROI classes, many tenants
+    assert {a.lane for a in sched} == {"edit", "bulk"}
+    assert {a.roi for a in sched} == {"small", "medium", "large"}
+    assert len({a.tenant for a in sched}) > 50
+
+
+def test_roi_class_maps_to_block_count():
+    sched = loadgen.generate_schedule(SPEC)
+    by_roi = {a.roi: a.n_blocks for a in sched}
+    assert by_roi == {"small": 1, "medium": 4, "large": 16}
+    pipe = loadgen.SyntheticPipeline(clock=loadgen.VirtualClock())
+    for a in sched[:10]:
+        assert pipe.request_n_blocks(loadgen.synthetic_volume(a)) \
+            == a.n_blocks
+
+
+# ---------------------------------------------------------------------------
+# virtual-time mode (deterministic tier-1 replay)
+# ---------------------------------------------------------------------------
+
+def _virtual(tmpdir, spec=SPEC, **kw):
+    return loadgen.run_virtual(spec, str(tmpdir),
+                               slo_engine=slo.SLOEngine(), **kw)
+
+
+def test_virtual_mode_identical_bucket_counts(tmp_path):
+    """The acceptance criterion: same seed -> identical request schedule
+    AND identical histogram bucket counts on the stub pipeline."""
+    rows = []
+    buckets = []
+    for d in ("a", "b"):
+        r = _virtual(tmp_path / d)
+        lat, wait, tenant = r["server"].latency_histograms()
+        rows.append(r)
+        buckets.append({
+            "lat": {k: h.cumulative() for k, h in lat.items()},
+            "wait": {k: h.cumulative() for k, h in wait.items()},
+            "tenant": {k: h.cumulative() for k, h in tenant.items()},
+        })
+    assert [tuple(a) for a in rows[0]["schedule"]] == \
+        [tuple(a) for a in rows[1]["schedule"]]
+    assert buckets[0] == buckets[1]
+    assert rows[0]["lanes"] == rows[1]["lanes"]
+    assert rows[0]["served"] == SPEC.n_requests
+
+
+def test_virtual_mode_latency_charged_from_scheduled_arrival(tmp_path):
+    """Open-loop semantics: under overload, latency includes the time a
+    request spent waiting BEHIND the schedule, so the tail compounds."""
+    hot = SPEC._replace(rate_hz=2000.0, n_requests=300)
+    r = _virtual(tmp_path, spec=hot)
+    # offered 2000 req/s vs ~60 req/s capacity: p99 must dwarf the
+    # isolated service time (worst class: 2+16*4+1 = 67 ms)
+    worst = max(v["p99_s"] for v in r["lanes"].values())
+    assert worst > 0.5
+    # and the SLO engine must call it overloaded
+    assert r["slo"]["overload"] is True
+
+
+def test_virtual_mode_unsaturated_has_no_overload(tmp_path):
+    light = SPEC._replace(rate_hz=20.0, n_requests=60)
+    r = _virtual(tmp_path, spec=light)
+    assert r["slo"]["overload"] is False
+    assert r["served"] == 60
+    assert r["failed"] == 0
+
+
+def test_fault_injection_feeds_availability(tmp_path):
+    clock = loadgen.VirtualClock()
+    pipe = loadgen.SyntheticPipeline(clock=clock, fail_every=5)
+    r = loadgen.run_virtual(SPEC._replace(n_requests=50), str(tmp_path),
+                            pipeline=pipe, slo_engine=slo.SLOEngine())
+    assert r["failed"] == 10
+    avail = [o for o in r["slo"]["objectives"]
+             if o["name"] == "availability"][0]
+    assert avail["windows"][-1]["bad"] >= 10
+
+
+def test_admission_hook_rejections_counted(tmp_path):
+    calls = []
+
+    def hook(tenant, lane, overloaded):
+        calls.append((tenant, lane, overloaded))
+        return lane != "bulk"        # shed the bulk lane entirely
+
+    r = _virtual(tmp_path, admission_hook=hook)
+    assert r["rejected"] > 0
+    assert "bulk" not in r["lanes"]
+    assert r["served"] + r["rejected"] == SPEC.n_requests
+    assert {l for _, l, _ in calls} == {"edit", "bulk"}
+
+
+def test_virtual_requires_clock_driven_pipeline(tmp_path):
+    with pytest.raises(ValueError):
+        loadgen.run_virtual(SPEC, str(tmp_path),
+                            pipeline=loadgen.SyntheticPipeline())
+
+
+# ---------------------------------------------------------------------------
+# threaded mode (real worker thread, real sleeps — kept tiny for tier-1)
+# ---------------------------------------------------------------------------
+
+def test_threaded_open_loop_smoke(tmp_path):
+    spec = loadgen.LoadSpec(seed=3, rate_hz=200.0, n_requests=40,
+                            n_tenants=10)
+    pipe = loadgen.SyntheticPipeline(prepare_s=1e-4, block_s=2e-4,
+                                     finalize_s=1e-4)
+    eng = slo.SLOEngine()
+    r = loadgen.run_threaded(spec, str(tmp_path), pipeline=pipe,
+                             slo_engine=eng, metrics_path=None)
+    assert r["drained"] is True
+    assert r["served"] == 40
+    assert r["mode"] == "threaded"
+    assert set(r["lanes"]) <= {"edit", "bulk"}
+    assert r["slo"]["objectives"]
+    for lane_row in r["lanes"].values():
+        assert 0.0 <= lane_row["p50_s"] <= lane_row["p99_s"]
+
+
+# ---------------------------------------------------------------------------
+# bench.py serve --smoke schema (no XLA, subprocess — satellite CI task)
+# ---------------------------------------------------------------------------
+
+def test_bench_serve_smoke_schema(tmp_path):
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "BENCH_serve_smoke.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py"), "serve",
+         "--smoke", "--out", out],
+        cwd=here, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.load(open(out))
+    assert doc["metric"] == "serve_load"
+    assert doc["mode"] == "smoke-virtual"
+    rows = doc["stub_levels"]
+    assert len(rows) >= 3
+    offered = [r["offered_hz"] for r in rows]
+    assert offered == sorted(offered) and len(set(offered)) >= 3
+    for row in rows:
+        for lane_row in row["lanes"].values():
+            for k in ("n", "p50_s", "p95_s", "p99_s"):
+                assert k in lane_row
+        assert "overload" in row["slo"]
+        for obj in row["slo"]["objectives"]:
+            for w in obj["windows"]:
+                assert "burn_rate" in w and "max_burn" in w
+    assert doc["slo_objectives"] and doc["burn_windows"]
+    # the real-pipeline row is the `slow` path, absent from --smoke
+    assert doc["real_pipeline"] is None
+    # the one-line summary the bench prints must be valid JSON
+    last = proc.stdout.strip().splitlines()[-1]
+    assert json.loads(last)["metric"] == "serve_load"

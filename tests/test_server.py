@@ -282,6 +282,202 @@ def test_server_request_spans(tmp_path):
                for s in blocks)
 
 
+def test_lane_in_status_log_and_spans(tmp_path):
+    """Every surface that names a request also names its lane: status
+    JSON, request log, queue-wait and request spans."""
+    from cluster_tools_tpu.core import telemetry
+
+    telemetry.configure(enabled=True)
+    pipe = StubPipeline(n_blocks=1)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe)
+    he = srv.submit("alice", "A", lane="edit")
+    hb = srv.submit("bob", "B")                      # default lane
+    srv.start()
+    srv.shutdown(drain=True)
+    with open(he.status_path) as f:
+        assert json.load(f)["lane"] == "edit"
+    with open(hb.status_path) as f:
+        assert json.load(f)["lane"] == "bulk"
+    lanes = {r["request_id"]: r["lane"]
+             for r in srv.stats()["requests"]}
+    assert lanes == {he.request_id: "edit", hb.request_id: "bulk"}
+    spans = telemetry.spans_snapshot()
+    for cat in ("queue-wait", "request"):
+        by_req = {s.attrs["request"]: s.attrs["lane"]
+                  for s in spans if s.cat == cat}
+        assert by_req == lanes
+
+
+def test_latency_histograms_per_lane_and_tenant(tmp_path):
+    pipe = StubPipeline(n_blocks=1)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe)
+    srv.submit("alice", "A", lane="edit")
+    srv.submit("alice", "B", lane="bulk")
+    srv.submit("bob", "C", lane="bulk")
+    srv.start()
+    srv.shutdown(drain=True)
+    lat, wait, tenant = srv.latency_histograms()
+    assert {l: h.count for l, h in lat.items()} == {"edit": 1, "bulk": 2}
+    assert {l: h.count for l, h in wait.items()} == {"edit": 1, "bulk": 2}
+    assert {t: h.count for t, h in tenant.items()} == \
+        {"alice": 2, "bob": 1}
+    for h in lat.values():
+        assert h.cumulative()["+Inf"] == h.count
+        assert h.quantile(0.5) is not None
+
+
+def test_occupancy_timeline_samples_all_events(tmp_path):
+    """Satellite fix: the occupancy timeline samples at enqueue, claim
+    AND completion — no blind spots between claims."""
+    pipe = StubPipeline(n_blocks=1)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe)
+    srv.submit("alice", "A")
+    srv.submit("bob", "B")
+    srv.start()
+    srv.shutdown(drain=True)
+    tl = srv.occupancy_timeline()
+    events = [s["event"] for s in tl]
+    assert events.count("enqueue") == 2
+    assert events.count("claim") == 2
+    assert events.count("done") == 2
+    for s in tl:
+        assert set(s) == {"t", "event", "queue_depth", "tenants"}
+    ts = [s["t"] for s in tl]
+    assert ts == sorted(ts)
+    # enqueue samples count the new request; done samples exclude the
+    # finished one — the final sample shows an empty server
+    assert tl[0] == {"t": tl[0]["t"], "event": "enqueue",
+                     "queue_depth": 1, "tenants": 1}
+    assert tl[-1]["event"] == "done"
+    assert tl[-1]["queue_depth"] == 0
+
+
+def test_drain_flushes_metrics_snapshot(tmp_path):
+    """Satellite: drain() flushes the throttled metrics.prom so the
+    post-drain snapshot is never stale (interval set huge to prove the
+    flush is the drain's, not the throttle's)."""
+    pipe = StubPipeline(n_blocks=1)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe,
+                                     metrics_interval_s=1e9)
+    srv.start()
+    srv.submit("alice", "A")
+    assert srv.drain(timeout=5.0)
+    text = open(srv.metrics_path).read()
+    assert "ctt_server_queue_depth 0" in text
+    assert 'ctt_server_requests_served_total{tenant="alice"} 1' in text
+    srv.shutdown()
+
+
+def test_metrics_prom_passes_lint_with_histograms_and_slo(tmp_path):
+    """The full serve snapshot — gauges, counters, per-lane/per-tenant
+    histograms, SLO burn rates, telemetry self-metrics — is valid
+    exposition format per the promtool-style lint."""
+    from cluster_tools_tpu.core import slo, telemetry
+
+    pipe = StubPipeline(n_blocks=1)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe,
+                                     slo=slo.SLOEngine())
+    srv.submit("alice", "A", lane="edit")
+    srv.submit("bob", "B", lane="bulk")
+    srv.start()
+    srv.shutdown(drain=True)
+    text = open(srv.metrics_path).read()
+    assert telemetry.lint_prometheus(text) == []
+    for family in ("ctt_server_request_latency_seconds_bucket",
+                   "ctt_server_queue_wait_seconds_bucket",
+                   "ctt_server_tenant_latency_seconds_bucket",
+                   "ctt_slo_burn_rate", "ctt_slo_compliance",
+                   "ctt_server_overload",
+                   "ctt_server_admission_rejected_total",
+                   "ctt_telemetry_dropped_spans_total"):
+        assert family in text, family
+    assert 'le="+Inf"' in text
+
+
+def test_step_once_requires_stopped_worker(tmp_path):
+    pipe = StubPipeline(n_blocks=1)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe)
+    srv.start()
+    with pytest.raises(RuntimeError, match="worker thread"):
+        srv.step_once()
+    srv.shutdown(drain=True)
+
+
+def test_step_once_drives_requests_synchronously(tmp_path):
+    pipe = StubPipeline(n_blocks=2)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe,
+                                     metrics_path="")
+    h = srv.submit("alice", "A")
+    steps = 0
+    while srv.step_once():
+        steps += 1
+    assert h.done() and h.result(0)["n_segments"] == 1
+    assert steps == 2                # one quantum per block
+    assert srv.step_once() is False  # idle
+
+
+def test_admission_hook_rejects_and_counts(tmp_path):
+    from cluster_tools_tpu.core.server import AdmissionRejected
+
+    seen = []
+
+    def hook(tenant, lane, overloaded):
+        seen.append((tenant, lane, overloaded))
+        return tenant != "mallory"
+
+    pipe = StubPipeline(n_blocks=1)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe,
+                                     admission_hook=hook)
+    with pytest.raises(AdmissionRejected):
+        srv.submit("mallory", "M", lane="edit")
+    h = srv.submit("alice", "A")
+    srv.start()
+    srv.shutdown(drain=True)
+    assert h.done()
+    assert seen == [("mallory", "edit", False), ("alice", "bulk", False)]
+    text = open(srv.metrics_path).read()
+    assert 'ctt_server_admission_rejected_total{lane="edit"} 1' in text
+
+
+def test_request_n_blocks_hook_varies_block_count(tmp_path):
+    """A pipeline exposing request_n_blocks sizes each request from its
+    payload (the load harness's mixed-ROI mechanism); the class
+    n_blocks is only the fallback."""
+
+    class SizedStub(StubPipeline):
+        def request_n_blocks(self, volume):
+            return len(volume)
+
+    pipe = SizedStub(n_blocks=99)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe)
+    h1 = srv.submit("alice", "AB")       # 2 blocks
+    h2 = srv.submit("bob", "XYZW")       # 4 blocks
+    srv.start()
+    srv.shutdown(drain=True)
+    with open(h1.status_path) as f:
+        assert json.load(f)["n_blocks"] == 2
+    with open(h2.status_path) as f:
+        assert json.load(f)["n_blocks"] == 4
+
+
+def test_slo_engine_fed_by_completions(tmp_path):
+    from cluster_tools_tpu.core import slo
+
+    eng = slo.SLOEngine()
+    pipe = StubPipeline(n_blocks=1, fail_tag="BAD")
+    srv = ResidentSegmentationServer(str(tmp_path), pipe, slo=eng,
+                                     metrics_path="")
+    srv.submit("alice", "A", lane="edit")
+    srv.submit("mallory", "BAD", lane="edit")
+    srv.start()
+    srv.shutdown(drain=True)
+    assert eng.total_events == 2
+    avail = [o for o in eng.report()["objectives"]
+             if o["name"] == "availability"][0]
+    assert avail["windows"][0]["bad"] == 1       # the failed request
+    assert srv.overloaded() in (False, True)     # consults the engine
+
+
 @pytest.mark.slow
 def test_real_pipeline_multi_tenant(tmp_path):
     """End-to-end on the REAL fused ROI pipeline (one shared tiny

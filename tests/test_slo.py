@@ -1,0 +1,138 @@
+"""SLO engine: burn rates, multi-window AND, lane filtering, overload."""
+
+import pytest
+
+from cluster_tools_tpu.core import slo
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _engine(objectives=None, windows=((10.0, 2.0), (100.0, 1.0))):
+    clock = FakeClock()
+    return slo.SLOEngine(objectives, windows=windows, clock=clock), clock
+
+
+def test_burn_rate_arithmetic():
+    # target 0.9 -> budget 0.1; 3 bad out of 10 -> err 0.3, burn 3.0
+    eng, clock = _engine([slo.Objective("avail", target=0.9)])
+    for i in range(10):
+        eng.record("bulk", 0.01, ok=(i >= 3))
+    rep = eng.report()
+    w = rep["objectives"][0]["windows"][0]
+    assert w["events"] == 10 and w["bad"] == 3
+    assert w["error_rate"] == pytest.approx(0.3)
+    assert w["burn_rate"] == pytest.approx(3.0)
+
+
+def test_latency_objective_counts_slow_requests_as_bad():
+    eng, clock = _engine(
+        [slo.Objective("lat", lane="edit", latency_s=0.25, target=0.5)])
+    eng.record("edit", 0.1)          # good
+    eng.record("edit", 0.5)          # bad: slow
+    eng.record("edit", 0.1, ok=False)  # bad: failed
+    w = eng.report()["objectives"][0]["windows"][0]
+    assert (w["events"], w["bad"]) == (3, 2)
+
+
+def test_lane_filtering_and_wildcard():
+    eng, clock = _engine([
+        slo.Objective("edit-only", lane="edit", latency_s=0.1,
+                      target=0.5),
+        slo.Objective("all", lane="*", target=0.5),
+    ])
+    eng.record("edit", 1.0)          # bad for edit-only, good for all
+    eng.record("bulk", 1.0)          # invisible to edit-only
+    rep = eng.report()
+    edit_w = rep["objectives"][0]["windows"][0]
+    all_w = rep["objectives"][1]["windows"][0]
+    assert (edit_w["events"], edit_w["bad"]) == (1, 1)
+    assert (all_w["events"], all_w["bad"]) == (2, 0)
+
+
+def test_multiwindow_and_rule_rejects_blips():
+    """A short error burst trips the fast window but not the slow one —
+    no breach.  Sustained errors trip both — breach + overload."""
+    eng, clock = _engine(
+        [slo.Objective("avail", target=0.9)],
+        windows=((10.0, 2.0), (100.0, 1.0)))
+    # 100 old GOOD events spread over the long window
+    for _ in range(100):
+        eng.record("bulk", 0.01)
+        clock.advance(0.5)           # clock at 50s
+    # burst: 10 bad events just now -> short-window burn huge, long
+    # window diluted by the 100 good events
+    for _ in range(10):
+        eng.record("bulk", 0.01, ok=False)
+    rep = eng.report()
+    short, long_ = rep["objectives"][0]["windows"]
+    assert short["breach"]
+    assert not long_["breach"]
+    assert not rep["objectives"][0]["breach"]
+    assert not rep["overload"]
+    # sustain the failures: everything in BOTH windows is bad
+    clock.advance(200.0)             # age out the good events
+    for _ in range(20):
+        eng.record("bulk", 0.01, ok=False)
+    assert eng.overload()
+
+
+def test_events_age_out_of_windows():
+    eng, clock = _engine([slo.Objective("avail", target=0.9)])
+    eng.record("bulk", 0.01, ok=False)
+    assert eng.report()["objectives"][0]["windows"][0]["bad"] == 1
+    clock.advance(1000.0)
+    rep = eng.report()
+    assert rep["objectives"][0]["windows"][1]["events"] == 0
+    assert not rep["overload"]
+
+
+def test_compliance_is_longest_window():
+    eng, clock = _engine([slo.Objective("avail", target=0.9)])
+    for i in range(10):
+        eng.record("bulk", 0.01, ok=(i != 0))
+    assert eng.report()["objectives"][0]["compliance"] == \
+        pytest.approx(0.9)
+
+
+def test_objectives_from_config():
+    objs = slo.objectives_from_config([
+        {"name": "x", "lane": "edit", "latency_s": 0.1, "target": 0.95},
+        {"name": "y"},
+    ])
+    assert objs[0] == slo.Objective("x", "edit", 0.1, 0.95)
+    assert objs[1] == slo.Objective("y", "*", None, 0.99)
+    assert slo.objectives_from_config(None) is None
+    assert slo.objectives_from_config([]) is None
+
+
+def test_invalid_target_rejected():
+    with pytest.raises(ValueError):
+        slo.SLOEngine([slo.Objective("bad", target=1.0)])
+    with pytest.raises(ValueError):
+        slo.SLOEngine([slo.Objective("bad", target=0.0)])
+    with pytest.raises(ValueError):
+        slo.SLOEngine(windows=())
+
+
+def test_metrics_families_shape():
+    from cluster_tools_tpu.core import telemetry
+
+    eng, clock = _engine()
+    eng.record("edit", 0.01)
+    fams = eng.metrics_families()
+    names = [f[0] for f in fams]
+    assert names == ["ctt_slo_burn_rate", "ctt_slo_compliance"]
+    for name in names:
+        assert telemetry.is_registered_metric(name)
+    burn = fams[0][3]
+    # one sample per objective x window (3 defaults x 2 windows)
+    assert len(burn) == len(eng.objectives) * len(eng.windows)

@@ -437,6 +437,380 @@ def test_prometheus_writer_format(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# cumulative-bucket histograms (ISSUE 16 tentpole 2)
+# ---------------------------------------------------------------------------
+
+def test_histogram_cumulative_buckets_and_quantiles():
+    h = telemetry.Histogram((0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(6.055)
+    cum = h.cumulative()
+    # Prometheus semantics: each le bucket counts ALL observations <= le
+    assert cum == {"0.01": 1, "0.1": 2, "1.0": 4, "+Inf": 5}
+    assert list(cum)[-1] == "+Inf"
+    # monotone non-decreasing in le order
+    vals = list(cum.values())
+    assert vals == sorted(vals)
+    assert h.quantile(0.0) <= h.quantile(0.5) <= h.quantile(0.99)
+    # p50 falls in the (0.1, 1.0] bucket
+    assert 0.1 <= h.quantile(0.5) <= 1.0
+    # quantiles beyond the finite buckets clamp to the highest bound
+    assert h.quantile(1.0) == pytest.approx(1.0)
+
+
+def test_histogram_boundary_observation_is_inclusive():
+    h = telemetry.Histogram((1.0,))
+    h.observe(1.0)                  # le="1.0" must include exactly 1.0
+    assert h.cumulative() == {"1.0": 1, "+Inf": 1}
+
+
+def test_histogram_merge_and_copy():
+    a = telemetry.Histogram((0.1, 1.0))
+    b = telemetry.Histogram((0.1, 1.0))
+    a.observe(0.05)
+    b.observe(0.5)
+    c = a.copy()
+    c.merge(b)
+    assert a.count == 1             # copy is independent
+    assert c.count == 2
+    assert c.cumulative() == {"0.1": 1, "1.0": 2, "+Inf": 2}
+    with pytest.raises(ValueError):
+        a.merge(telemetry.Histogram((0.5,)))
+
+
+def test_histogram_to_samples_prometheus_invariants(tmp_path):
+    h = telemetry.Histogram((0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    samples = h.to_samples({"lane": "edit"})
+    suffixes = [s[0] for s in samples]
+    assert suffixes == ["_bucket", "_bucket", "_bucket", "_sum",
+                        "_count"]
+    les = [s[1]["le"] for s in samples[:3]]
+    assert les == ["0.1", "1.0", "+Inf"]
+    assert samples[2][2] == samples[4][2] == 3    # +Inf == _count
+    # round-trip through the writer and the lint
+    path = str(tmp_path / "h.prom")
+    telemetry.write_prometheus(path, [telemetry.histogram_family(
+        "ctt_server_request_latency_seconds", "Request latency",
+        [({"lane": "edit"}, h)])])
+    text = open(path).read()
+    assert telemetry.lint_prometheus(text) == []
+    assert 'ctt_server_request_latency_seconds_bucket' \
+        '{lane="edit",le="+Inf"} 3' in text
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format lint (promtool-style, satellite)
+# ---------------------------------------------------------------------------
+
+def _lint(text):
+    return telemetry.lint_prometheus(text)
+
+
+def test_lint_accepts_generated_snapshot(tmp_path):
+    path = str(tmp_path / "ok.prom")
+    h = telemetry.Histogram((0.5,))
+    h.observe(0.1)
+    telemetry.write_prometheus(path, [
+        ("ctt_server_queue_depth", "gauge", "Depth", [(None, 2)]),
+        ("ctt_server_in_flight", "gauge", "In flight",
+         [({"tenant": 'a\\b"c'}, 1)]),            # escaping round-trips
+        telemetry.histogram_family("ctt_server_queue_wait_seconds",
+                                   "Wait", [(None, h)]),
+    ] + telemetry.metrics_families())
+    assert _lint(open(path).read()) == []
+
+
+def test_lint_rejects_malformed_exposition():
+    # sample with no TYPE
+    assert _lint("ctt_x 1\n")
+    # invalid metric name
+    assert _lint("# TYPE 0bad gauge\n0bad 1\n")
+    # invalid label syntax (unquoted value)
+    assert _lint('# TYPE ctt_x gauge\nctt_x{l=a} 1\n')
+    # bad escape in a label value
+    assert _lint('# TYPE ctt_x gauge\nctt_x{l="a\\q"} 1\n')
+    # non-float value
+    assert _lint("# TYPE ctt_x gauge\nctt_x abc\n")
+    # duplicate series
+    assert _lint("# TYPE ctt_x gauge\nctt_x 1\nctt_x 2\n")
+    # unknown TYPE
+    assert _lint("# TYPE ctt_x wibble\nctt_x 1\n")
+
+
+def test_lint_enforces_histogram_invariants():
+    head = "# TYPE ctt_h histogram\n"
+    # non-monotone cumulative buckets
+    bad_mono = head + ('ctt_h_bucket{le="0.1"} 5\n'
+                       'ctt_h_bucket{le="1.0"} 3\n'
+                       'ctt_h_bucket{le="+Inf"} 5\n'
+                       'ctt_h_sum 1\nctt_h_count 5\n')
+    assert any("monoton" in e for e in _lint(bad_mono))
+    # missing +Inf bucket
+    bad_inf = head + ('ctt_h_bucket{le="0.1"} 1\n'
+                      'ctt_h_sum 1\nctt_h_count 1\n')
+    assert any("+Inf" in e for e in _lint(bad_inf))
+    # +Inf disagrees with _count
+    bad_count = head + ('ctt_h_bucket{le="+Inf"} 4\n'
+                        'ctt_h_sum 1\nctt_h_count 5\n')
+    assert any("_count" in e for e in _lint(bad_count))
+    # missing _sum
+    bad_sum = head + ('ctt_h_bucket{le="+Inf"} 1\nctt_h_count 1\n')
+    assert any("_sum" in e for e in _lint(bad_sum))
+    # a correct family passes
+    good = head + ('ctt_h_bucket{le="0.1"} 1\n'
+                   'ctt_h_bucket{le="+Inf"} 2\n'
+                   'ctt_h_sum 0.3\nctt_h_count 2\n')
+    assert _lint(good) == []
+
+
+# ---------------------------------------------------------------------------
+# metric-name registry lint (satellite: the stage-lint pattern extended
+# to Prometheus family names)
+# ---------------------------------------------------------------------------
+
+_METRIC_LITERAL = re.compile(r'"(ctt_[a-zA-Z0-9_]+)"')
+
+
+def test_metric_literals_are_registered():
+    """Every `ctt_*` family-name literal in the package (and bench.py)
+    must be in telemetry.METRIC_REGISTRY — a typo'd metric name fails
+    tier-1 instead of silently forking a new time series."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(here, "bench.py")]
+    pkg = os.path.join(here, "cluster_tools_tpu")
+    for root, _dirs, files in os.walk(pkg):
+        paths += [os.path.join(root, fn) for fn in files
+                  if fn.endswith(".py")]
+    found = {}
+    for path in paths:
+        with open(path) as f:
+            src = f.read()
+        for m in _METRIC_LITERAL.finditer(src):
+            found.setdefault(m.group(1), []).append(
+                os.path.relpath(path, here))
+    assert found, "metric lint found no ctt_ literals — regex rotted?"
+    unregistered = {n: fs for n, fs in found.items()
+                    if not telemetry.is_registered_metric(n)}
+    assert not unregistered, (
+        f"unregistered metric names {unregistered} — add them to "
+        "telemetry.METRIC_REGISTRY (or fix the typo)")
+    # the serve-path families this PR adds must actually be emitted
+    for name in ("ctt_server_request_latency_seconds",
+                 "ctt_slo_burn_rate",
+                 "ctt_telemetry_dropped_spans_total"):
+        assert name in found
+
+
+def test_dropped_span_counter_exported(fake_clock, tmp_path):
+    """The ring's dropped-span count surfaces as a Prometheus counter
+    (satellite: silent drops were invisible before)."""
+    telemetry.configure(ring_size=4)
+    for i in range(10):
+        telemetry.record("host-map", float(i), float(i) + 0.5)
+    path = str(tmp_path / "m.prom")
+    telemetry.write_prometheus(path, telemetry.metrics_families())
+    text = open(path).read()
+    assert "# TYPE ctt_telemetry_dropped_spans_total counter" in text
+    assert "ctt_telemetry_dropped_spans_total 6" in text
+    assert "ctt_telemetry_ring_spans 4" in text
+    assert _lint(text) == []
+
+
+# ---------------------------------------------------------------------------
+# trace-diff regression gate (ISSUE 16 tentpole 3)
+# ---------------------------------------------------------------------------
+
+_BASE_ROLLUPS = {
+    "stage_seconds": {"sync-execute": 8.0, "h2d-upload": 0.6,
+                      "host-solve": 2.0},
+    "device_busy_s": 8.6,
+    "pipeline_bubble_frac": 0.02,
+}
+
+
+def test_diff_rollups_pass_path():
+    """Candidate within thresholds (including small improvements): no
+    regressions, exit-0 path."""
+    cand = {
+        "stage_seconds": {"sync-execute": 8.2, "h2d-upload": 0.5,
+                          "host-solve": 2.2},   # host +10%: warning only
+        "device_busy_s": 8.7,
+        "pipeline_bubble_frac": 0.03,
+    }
+    diff = telemetry.diff_rollups(_BASE_ROLLUPS, cand)
+    assert diff["regressed"] is False
+    assert diff["regressions"] == []
+    assert diff["stages"]["sync-execute"]["regressed"] is False
+
+
+def test_diff_rollups_fail_path_device_busy():
+    """A device stage past threshold regresses AND the device-busy total
+    regresses — the acceptance criterion's nonzero-exit condition."""
+    cand = {
+        "stage_seconds": {"sync-execute": 12.0, "h2d-upload": 0.6,
+                          "host-solve": 2.0},
+        "device_busy_s": 12.6,
+        "pipeline_bubble_frac": 0.02,
+    }
+    diff = telemetry.diff_rollups(_BASE_ROLLUPS, cand)
+    assert diff["regressed"] is True
+    assert "stage:sync-execute" in diff["regressions"]
+    assert "device_busy_s" in diff["regressions"]
+    assert diff["device_busy"]["delta_s"] == pytest.approx(4.0)
+
+
+def test_diff_rollups_host_regression_warns_not_gates():
+    cand = dict(_BASE_ROLLUPS,
+                stage_seconds={"sync-execute": 8.0, "h2d-upload": 0.6,
+                               "host-solve": 9.0})
+    diff = telemetry.diff_rollups(_BASE_ROLLUPS, cand)
+    assert diff["regressed"] is False
+    assert "stage:host-solve" in diff["warnings"]
+
+
+def test_diff_rollups_abs_floor_ignores_micro_stages():
+    base = {"stage_seconds": {"sync-execute": 0.001},
+            "device_busy_s": 0.001}
+    cand = {"stage_seconds": {"sync-execute": 0.01},
+            "device_busy_s": 0.01}   # 10x relative but under the floor
+    diff = telemetry.diff_rollups(base, cand)
+    assert diff["regressed"] is False
+
+
+def test_diff_rollups_bubble_gate():
+    cand = dict(_BASE_ROLLUPS, pipeline_bubble_frac=0.2)
+    diff = telemetry.diff_rollups(_BASE_ROLLUPS, cand)
+    assert diff["regressed"] is True
+    assert "pipeline_bubble_frac" in diff["regressions"]
+    # configurable threshold: widen it and the gate opens
+    ok = telemetry.diff_rollups(_BASE_ROLLUPS, cand, bubble_abs=0.5)
+    assert ok["regressed"] is False
+
+
+def test_diff_rollups_new_stage_in_candidate_gates():
+    """A device stage absent from the baseline is pure regression."""
+    cand = dict(_BASE_ROLLUPS)
+    cand = {**_BASE_ROLLUPS,
+            "stage_seconds": {**_BASE_ROLLUPS["stage_seconds"],
+                              "sync-meta": 1.0}}
+    diff = telemetry.diff_rollups(_BASE_ROLLUPS, cand)
+    assert "stage:sync-meta" in diff["regressions"]
+
+
+def test_bench_trace_diff_cli_pass_and_fail(tmp_path):
+    """End-to-end CLI: exit 0 on self-compare, nonzero on a synthetic
+    device-busy regression (both paths of the acceptance criterion)."""
+    import subprocess
+    import sys as _sys
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = str(tmp_path / "base.json")
+    regr = str(tmp_path / "regr.json")
+    with open(base, "w") as f:
+        json.dump({"rollups": _BASE_ROLLUPS}, f)
+    cand = {**_BASE_ROLLUPS, "device_busy_s": 12.6,
+            "stage_seconds": {**_BASE_ROLLUPS["stage_seconds"],
+                              "sync-execute": 12.0}}
+    with open(regr, "w") as f:
+        json.dump({"rollups": cand}, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(a, b):
+        return subprocess.run(
+            [_sys.executable, os.path.join(here, "bench.py"),
+             "trace-diff", a, b],
+            cwd=here, env=env, capture_output=True, text=True,
+            timeout=120)
+
+    ok = run(base, base)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert json.loads(ok.stdout)["regressed"] is False
+    bad = run(base, regr)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    out = json.loads(bad.stdout)
+    assert out["regressed"] is True
+    assert "device_busy_s" in out["regressions"]
+
+
+# ---------------------------------------------------------------------------
+# correlation propagation (satellite: exemplar-style linking)
+# ---------------------------------------------------------------------------
+
+def test_correlation_scope_attaches_to_spans_and_records(fake_clock):
+    with telemetry.correlation("aaaabbbbcccc"):
+        assert telemetry.current_correlation() == "aaaabbbbcccc"
+        with telemetry.span("work", cat="stage"):
+            pass
+        telemetry.record("host-map", 0.0, 1.0)
+        with telemetry.correlation("ddddeeeeffff"):   # nesting: inner wins
+            telemetry.record("host-map", 1.0, 2.0)
+    telemetry.record("host-map", 2.0, 3.0)            # outside: no corr
+    spans = telemetry.spans_snapshot()
+    corr = [s.attrs.get("corr") for s in spans]
+    assert corr == ["aaaabbbbcccc", "aaaabbbbcccc", "ddddeeeeffff",
+                    None]
+    assert telemetry.current_correlation() is None
+
+
+def test_correlation_explicit_attr_not_overwritten(fake_clock):
+    with telemetry.correlation("aaaabbbbcccc"):
+        telemetry.record("host-map", 0.0, 1.0, corr="explicit")
+    (s,) = telemetry.spans_snapshot()
+    assert s.attrs["corr"] == "explicit"
+
+
+def test_correlation_in_chrome_trace_args(fake_clock, tmp_path):
+    """The join key lands in the exported Chrome-trace args, so a
+    histogram outlier joins back to its Perfetto spans."""
+    with telemetry.correlation("abc123def456"):
+        with telemetry.span("attempt", cat="attempt"):
+            telemetry.record_stage("sync-execute", 0.5)
+    path = str(tmp_path / "t.json")
+    telemetry.export_chrome_trace(path)
+    with open(path) as f:
+        xs = [e for e in json.load(f)["traceEvents"] if e["ph"] == "X"]
+    assert xs
+    for e in xs:
+        assert e["args"]["corr"] == "abc123def456"
+
+
+def test_retry_attempt_children_inherit_correlation(tmp_path):
+    """End-to-end: worker-thread job/stage spans recorded inside a
+    retried task's attempts carry the attempt's 12-hex id in attrs —
+    the correlation stack is process-global on purpose."""
+    config_dir = str(tmp_path / "configs")
+    ConfigDir(config_dir).write_global_config(
+        {"block_shape": [10, 10, 10], "max_num_retries": 2,
+         "telemetry_enabled": True})
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir)
+    task = FailingTask(output_path=str(tmp_path / "out.n5"),
+                       output_key="data", shape=(20, 20, 20),
+                       tmp_folder=str(tmp_path / "t"),
+                       config_dir=config_dir, max_jobs=4,
+                       target="threads")
+    orig = task.run_jobs
+
+    def run_jobs(block_list, cfg, **kw):
+        return orig(block_list, {**cfg, "marker_dir": marker_dir}, **kw)
+
+    task.run_jobs = run_jobs
+    task.run()
+    spans = telemetry.spans_snapshot()
+    attempts = [s for s in spans if s.cat == "attempt"]
+    (corr,) = {s.attrs["correlation_id"] for s in attempts}
+    assert re.fullmatch(r"[0-9a-f]{12}", corr)
+    jobs = [s for s in spans if s.cat == "job"]
+    assert jobs
+    for j in jobs:
+        assert j.attrs.get("corr") == corr, j
+
+
+# ---------------------------------------------------------------------------
 # telemetry-off overhead gate (CI satellite: wired into tier-1)
 # ---------------------------------------------------------------------------
 
