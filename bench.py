@@ -55,7 +55,6 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 import json
 import os
-import resource
 import shutil
 import subprocess
 import sys
@@ -484,6 +483,9 @@ def main_mesh():
         "per_block": block_entry,
         "mesh": rows,
     }
+    from cluster_tools_tpu.core import telemetry
+    out["memory"] = telemetry.memory_rollup()
+    out["peak_rss_gb"] = round(telemetry.host_peak_rss_gb(), 2)
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_mesh.json")
     with open(path, "w") as f:
@@ -818,7 +820,9 @@ def main():
     voi_delta = round(abs((dev_sub_m["voi_split"] + dev_sub_m["voi_merge"])
                           - (cpu_m["voi_split"] + cpu_m["voi_merge"])), 4)
 
-    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    from cluster_tools_tpu.core import telemetry
+
+    peak_rss_gb = telemetry.host_peak_rss_gb()
     print(f"device full (median of {n_trials}): {dev_t:.1f}s {dev_m}; cpu "
           f"baseline ({n_cpu_voxels/1e6:.0f} Mvox subvolume, median of "
           f"{n_cpu_trials}): {cpu_t:.1f}s {cpu_m}; device-on-subvolume "
@@ -1161,6 +1165,9 @@ def main_serve():
         "stub_levels": rows,
         "real_pipeline": real_row,
     }
+    from cluster_tools_tpu.core import telemetry
+    out["memory"] = telemetry.memory_rollup()
+    out["peak_rss_gb"] = round(telemetry.host_peak_rss_gb(), 2)
     if out_path is None and not smoke:
         here = os.path.dirname(os.path.abspath(__file__))
         out_path = os.path.join(here, "BENCH_serve.json")
@@ -1208,6 +1215,9 @@ def main_trace_diff(argv):
     p.add_argument("--bubble-abs", type=float, default=0.05,
                    help="absolute pipeline-bubble-fraction worsening "
                         "that regresses (default 0.05)")
+    p.add_argument("--mem-abs-floor-gb", type=float, default=0.25,
+                   help="absolute floor in GiB under which peak-memory "
+                        "deltas never regress (default 0.25)")
     args = p.parse_args(argv)
 
     def load_rollups(path):
@@ -1219,7 +1229,8 @@ def main_trace_diff(argv):
     diff = telemetry.diff_rollups(
         load_rollups(args.baseline), load_rollups(args.candidate),
         rel_threshold=args.rel_threshold, abs_floor_s=args.abs_floor_s,
-        bubble_abs=args.bubble_abs)
+        bubble_abs=args.bubble_abs,
+        mem_abs_floor_gb=args.mem_abs_floor_gb)
     print(json.dumps(diff, indent=1))
     sys.exit(1 if diff["regressed"] else 0)
 

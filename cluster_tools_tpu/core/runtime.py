@@ -351,18 +351,20 @@ def _disk_load(key):
     return ent
 
 
-def _disk_store(key, ent) -> None:
+def _disk_store(key, ent) -> int:
     """Persist ``ent`` (best-effort: executables that cannot serialize —
-    e.g. callbacks capturing host state — just stay memory-only)."""
+    e.g. callbacks capturing host state — just stay memory-only).
+    Returns the serialized blob size (0 when nothing was persisted) —
+    the ledger's estimate of the executable's pinned footprint."""
     se = _serialize_api()
     if se is None:
-        return
+        return 0
     import pickle
 
     try:
         blob = pickle.dumps(se.serialize(ent))
     except Exception:
-        return
+        return 0
     cache_dir = _exec_cache_dir()
     path = _exec_cache_path(key)
     try:
@@ -372,9 +374,10 @@ def _disk_store(key, ent) -> None:
             f.write(blob)
         os.replace(tmp, path)   # atomic: readers never see a torn blob
     except OSError:
-        return
+        return len(blob)
     EXEC_CACHE_STATS["disk_writes"] += 1
     _disk_evict(_exec_cache_max_bytes())
+    return len(blob)
 
 
 def _disk_evict(max_bytes: int) -> None:
@@ -431,12 +434,18 @@ def compile_cached(key, build_fn, persist: bool = True):
         ent = _disk_load(key)
         if ent is not None:
             _EXEC_CACHE[key] = ent
+            # resident-footprint estimate = the serialized blob size
+            try:
+                nbytes = os.path.getsize(_exec_cache_path(key))
+            except OSError:
+                nbytes = 0
+            ledger_add("exec_cache", nbytes, 1)
             return ent
     ent = build_fn()
     _EXEC_CACHE[key] = ent
     EXEC_CACHE_STATS["compiles"] += 1
-    if disk:
-        _disk_store(key, ent)
+    nbytes = _disk_store(key, ent) if disk else 0
+    ledger_add("exec_cache", nbytes, 1)
     return ent
 
 
@@ -464,6 +473,7 @@ def metrics_families():
     the flat accumulators plus executable-cache activity + hit ratio."""
     st, cn, by = stages_snapshot(), counts_snapshot(), bytes_snapshot()
     ec = exec_cache_snapshot()
+    led = ledger_snapshot()
     hits = int(ec.get("hits", 0))
     compiles = int(ec.get("compiles", 0))
     ratio = hits / (hits + compiles) if (hits + compiles) else 0.0
@@ -484,6 +494,15 @@ def metrics_families():
         ("ctt_exec_cache_hit_ratio", "gauge",
          "Executable-cache memory-tier hit ratio (hits/(hits+compiles))",
          [(None, round(ratio, 6))]),
+        ("ctt_ledger_bytes", "gauge",
+         "Live bytes pinned per buffer-ledger account (exec cache, "
+         "fragment/raw caches)",
+         [({"account": k}, int(v["bytes"]))
+          for k, v in sorted(led.items())] or [(None, 0)]),
+        ("ctt_ledger_entries", "gauge",
+         "Live entries per buffer-ledger account",
+         [({"account": k}, int(v["entries"]))
+          for k, v in sorted(led.items())] or [(None, 0)]),
     ]
 
 
@@ -494,6 +513,7 @@ def exec_cache_clear(disk: bool = False) -> None:
     persisted blobs of the configured disk tier — the full
     cold-start reset the warm-path bench uses between cold trials."""
     _EXEC_CACHE.clear()
+    ledger_clear("exec_cache")
     for k in EXEC_CACHE_STATS:
         EXEC_CACHE_STATS[k] = 0.0 if k == "deserialize_s" else 0
     if disk:
@@ -506,6 +526,49 @@ def exec_cache_clear(disk: bool = False) -> None:
                         os.remove(os.path.join(cache_dir, name))
                     except OSError:
                         pass
+
+
+# ---------------------------------------------------------------------------
+# live-buffer ledger: bytes pinned by long-lived caches (ISSUE 17).  The
+# exec cache and the warm fragment caches hold memory for the PROCESS
+# lifetime — exactly the part of RSS/HBM a leak hides in.  Accounts are
+# updated at the cache mutation sites (compile_cached below,
+# workflows/fused_pipeline's fragment/raw caches) and exported as
+# ``ctt_ledger_bytes``/``ctt_ledger_entries`` gauges plus a ``ledger``
+# section in task/request status JSONs next to ``exec_cache``.
+# ---------------------------------------------------------------------------
+
+_LEDGER_LOCK = threading.Lock()
+_LEDGER: Dict[str, Dict[str, int]] = {}
+
+
+def ledger_add(account: str, nbytes: int, entries: int = 1) -> None:
+    """Charge ``nbytes``/``entries`` (may be negative) to an account."""
+    with _LEDGER_LOCK:
+        acc = _LEDGER.setdefault(account, {"bytes": 0, "entries": 0})
+        acc["bytes"] = max(acc["bytes"] + int(nbytes), 0)
+        acc["entries"] = max(acc["entries"] + int(entries), 0)
+
+
+def ledger_set(account: str, nbytes: int, entries: int) -> None:
+    """Overwrite an account (for caches that recompute their footprint)."""
+    with _LEDGER_LOCK:
+        _LEDGER[account] = {"bytes": max(int(nbytes), 0),
+                            "entries": max(int(entries), 0)}
+
+
+def ledger_clear(account: Optional[str] = None) -> None:
+    """Drop one account (its cache was cleared) or, with None, all."""
+    with _LEDGER_LOCK:
+        if account is None:
+            _LEDGER.clear()
+        else:
+            _LEDGER.pop(account, None)
+
+
+def ledger_snapshot() -> Dict[str, Dict[str, int]]:
+    with _LEDGER_LOCK:
+        return {k: dict(v) for k, v in sorted(_LEDGER.items())}
 
 
 def log(msg: str, stream=None) -> None:
@@ -1161,11 +1224,15 @@ class BlockTask(Task):
             self._corr_id = uuid.uuid4().hex[:12]
         stages_before = self._attempt_stages
         if my_jobs:
+            # process identity on the span (satellite 2): single-shard
+            # traces stay self-describing before any merge
             with telemetry.correlation(self._corr_id), \
                     telemetry.span(self.name_with_id, cat="attempt",
                                    correlation_id=self._corr_id,
                                    attempt=self._retry_count,
-                                   n_jobs=len(my_jobs)):
+                                   n_jobs=len(my_jobs),
+                                   process_index=pid,
+                                   process_count=pc):
                 executor.run(self, my_jobs)
         # the jobs barrier waits for REAL work (on global tasks, peers sit
         # here for the lead's entire job) — default unbounded, overridable
@@ -1289,8 +1356,19 @@ class BlockTask(Task):
             # dispatch is assertable per task, the same way stage_counts
             # made wait counts assertable
             "exec_cache": dict(exec_cache or {}),
+            # live-buffer ledger at task completion: bytes pinned by the
+            # long-lived caches (exec cache, fragment/raw) — the part of
+            # RSS the per-stage accounting can't see
+            "ledger": ledger_snapshot(),
             "correlation_id": self._corr_id,
         }
+        # multihost runs are self-describing per shard (satellite 2):
+        # which process wrote this status, out of how many
+        from ..parallel import multihost as mh
+
+        if mh.process_count() > 1:
+            status["process_index"] = mh.process_index()
+            status["process_count"] = mh.process_count()
         config_mod.write_config(self.output().path, status)
         # optional Prometheus snapshot alongside the status (deployment
         # opt-in via the global config; the resident server maintains its

@@ -757,9 +757,11 @@ class ResidentSegmentationServer:
                                  lane=req.lane)
             bid = req.next_block
             with telemetry.span(f"block:{bid}", cat="block", block=bid,
-                                tenant=req.tenant, request=req.req_id):
+                                tenant=req.tenant,
+                                request=req.req_id) as sp:
                 req.block_results.append(
                     self.pipeline.run_block(req.ctx, bid))
+                telemetry.annotate_memory(sp)
             req.next_block += 1
             if req.next_block >= req.n_blocks:
                 req.result = self.pipeline.finalize(req.ctx,
@@ -768,6 +770,13 @@ class ResidentSegmentationServer:
         except Exception as e:          # noqa: BLE001 — isolate tenants
             req.error = f"{type(e).__name__}: {e}"
             self._finish(req, "failed")
+            # postmortem dump for the faulted request: span ring, memory
+            # timeline, queue state and the correlation id — best-effort
+            # (the recorder must never take down the worker)
+            try:
+                self._flight_record(req)
+            except Exception:           # noqa: BLE001 — telemetry only
+                pass
         finally:
             # the worker serializes quanta, so these per-step deltas are
             # EXACTLY this request's activity — no cross-tenant bleed
@@ -838,6 +847,35 @@ class ResidentSegmentationServer:
         if self.slo is not None:
             self.slo.record(req.lane, lat, ok=(state == "done"))
 
+    def _flight_record(self, req: _Request) -> Optional[str]:
+        """Dump a flight-recorder snapshot for a faulted request into the
+        server workdir: queue/SLO state plus the in-flight correlation
+        ids, on top of telemetry's span ring + memory timeline."""
+        with self._lock:
+            depth, inflight = self._gauges_locked()
+            pending = [r.req_id for q in self._queues.values() for r in q]
+        rep = None
+        if self.slo is not None:
+            try:
+                rep = self.slo.report()
+            except Exception:           # noqa: BLE001 — telemetry only
+                rep = None
+        return telemetry.flight_record(
+            self.workdir, f"tenant-fault:{req.req_id}",
+            extra={
+                "request": req.req_id,
+                "tenant": req.tenant,
+                "lane": req.lane,
+                "error": req.error,
+                "blocks_done": req.next_block,
+                "n_blocks": req.n_blocks,
+                "queue_depth": int(depth),
+                "in_flight": {t: int(n) for t, n in sorted(
+                    inflight.items())},
+                "pending_requests": pending,
+                "slo": rep,
+            })
+
     def _write_status(self, req: _Request) -> None:
         now = self._clock()
         status = {
@@ -859,6 +897,9 @@ class ResidentSegmentationServer:
             "stage_counts": dict(sorted(req.stage_counts.items(),
                                         key=lambda kv: -kv[1])),
             "exec_cache": dict(req.exec_cache),
+            # live bytes pinned by the warm caches at status-write time
+            # (server-wide accounts, not per-request deltas)
+            "ledger": runtime.ledger_snapshot(),
             # scheduler gauges as this request saw them: snapshotted at
             # submit, re-snapshotted when the worker claimed the request
             "queue_depth": int(req.queue_depth),

@@ -122,6 +122,11 @@ METRIC_REGISTRY = {
     "ctt_slo_burn_rate", "ctt_slo_compliance",
     # telemetry self-metrics (metrics_families below)
     "ctt_telemetry_dropped_spans_total", "ctt_telemetry_ring_spans",
+    # memory observability (memory probe + flight recorder below)
+    "ctt_memory_host_gb", "ctt_memory_device_gb",
+    "ctt_telemetry_flight_records_total",
+    # live-buffer ledger gauges (core/runtime.py metrics_families)
+    "ctt_ledger_bytes", "ctt_ledger_entries",
 }
 
 
@@ -211,8 +216,9 @@ def configure(enabled: Optional[bool] = None,
 
 def reset() -> None:
     """Restore defaults: disabled, empty default-size ring, real clock,
-    span ids from 1.  Tests call this (conftest autouse) so telemetry
-    state never leaks between tests."""
+    span ids from 1, flight-recorder counter zeroed.  Tests call this
+    (conftest autouse) so telemetry state never leaks between tests."""
+    global _FLIGHT_COUNT
     with _REC.lock:
         _REC.enabled = False
         _REC.clock = time.perf_counter
@@ -221,6 +227,8 @@ def reset() -> None:
         _REC._next_sid = itertools.count(1)
         _REC._tls = threading.local()
         _REC.corr = []
+    with _FLIGHT_LOCK:
+        _FLIGHT_COUNT = 0
 
 
 class _CorrCtx:
@@ -301,6 +309,9 @@ class _NullSpan:
     def __exit__(self, *exc):
         return False
 
+    def annotate(self, **attrs):
+        """No-op twin of :meth:`_SpanCtx.annotate`."""
+
 
 _NULL_SPAN = _NullSpan()
 
@@ -334,6 +345,12 @@ class _SpanCtx:
                                    th.name, _attach_corr(self.attrs)))
         return False
 
+    def annotate(self, **attrs):
+        """Attach attrs to the still-open span (recorded at __exit__) —
+        how drain points stamp memory high-water marks on block/slab
+        spans after the block's work ran."""
+        self.attrs.update(attrs)
+
 
 def span(name: str, cat: str = "stage", **attrs):
     """Context manager opening a span; children recorded on the same
@@ -355,48 +372,208 @@ def dropped_count() -> int:
 
 
 # ---------------------------------------------------------------------------
+# memory probe (host RSS + device HBM) and counter-track sampling
+# ---------------------------------------------------------------------------
+
+_GIB = 1024.0 ** 3
+
+
+def host_memory_bytes() -> Dict[str, int]:
+    """Current host memory: ``{"rss": bytes, "hwm": peak bytes}``.
+
+    Primary source is ``/proc/self/status`` (VmRSS/VmHWM, kB lines);
+    fallback is ``resource.getrusage`` whose ``ru_maxrss`` is KiB on
+    Linux — both are converted with 1024-based factors (the ad-hoc
+    ``/1e6`` reads this helper replaces under-stated GiB by ~5%)."""
+    out = {"rss": 0, "hwm": 0}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["rss"] = int(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    out["hwm"] = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    if not out["hwm"]:
+        try:
+            import resource
+
+            kib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            out["hwm"] = int(kib) * 1024
+            out["rss"] = out["rss"] or out["hwm"]
+        except Exception:
+            pass
+    return out
+
+
+def host_peak_rss_gb() -> float:
+    """Peak host RSS in GiB (1024-based) — THE shared helper every
+    artifact's ``peak_rss_gb`` field records (bench.py satellite)."""
+    return host_memory_bytes()["hwm"] / _GIB
+
+
+def device_memory_bytes() -> Optional[Dict[str, int]]:
+    """Device memory from ``device.memory_stats()``:
+    ``{"in_use": bytes, "peak": bytes}``, or None where the backend has
+    no allocator stats (CPU jaxlib) — a graceful no-op, never an import
+    or backend-init side effect (only consults an ALREADY-imported
+    jax)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        devs = jax.devices()
+        if not devs:
+            return None
+        stats = devs[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    in_use = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use", in_use)
+    if in_use is None:
+        return None
+    return {"in_use": int(in_use), "peak": int(peak or in_use)}
+
+
+def memory_watermarks() -> Dict[str, float]:
+    """Current memory readings as span attrs (GiB, ``mem_`` prefix):
+    host rss/hwm always, device in-use/peak where the allocator exposes
+    stats.  Drain points stamp these on ``block:``/``slab:`` spans via
+    :meth:`_SpanCtx.annotate`."""
+    host = host_memory_bytes()
+    out = {"mem_host_rss_gb": round(host["rss"] / _GIB, 4),
+           "mem_host_hwm_gb": round(host["hwm"] / _GIB, 4)}
+    dev = device_memory_bytes()
+    if dev is not None:
+        out["mem_dev_in_use_gb"] = round(dev["in_use"] / _GIB, 4)
+        out["mem_dev_peak_gb"] = round(dev["peak"] / _GIB, 4)
+    return out
+
+
+def sample_memory(**attrs) -> Optional[int]:
+    """Record one memory counter sample (a zero-duration span with
+    ``cat='counter'``): the exporter turns each numeric attr into a
+    Chrome 'C' event, so the samples render as Perfetto counter tracks
+    (host_rss_gb / host_hwm_gb / dev_in_use_gb / dev_peak_gb).  No-op
+    when disabled."""
+    if not _REC.enabled:
+        return None
+    vals: Dict[str, Any] = {}
+    host = host_memory_bytes()
+    vals["host_rss_gb"] = round(host["rss"] / _GIB, 4)
+    vals["host_hwm_gb"] = round(host["hwm"] / _GIB, 4)
+    dev = device_memory_bytes()
+    if dev is not None:
+        vals["dev_in_use_gb"] = round(dev["in_use"] / _GIB, 4)
+        vals["dev_peak_gb"] = round(dev["peak"] / _GIB, 4)
+    vals.update(attrs)
+    t = _REC.clock()
+    return record("mem", t, t, cat="counter", **vals)
+
+
+def annotate_memory(sp) -> None:
+    """Drain-point hook: stamp memory watermarks on the open span AND
+    drop a counter sample at the same instant.  One ``enabled`` check —
+    telemetry off pays a single attribute read."""
+    if not _REC.enabled:
+        return
+    sp.annotate(**memory_watermarks())
+    sample_memory()
+
+
+class MemorySampler:
+    """Optional background sampling probe: one daemon thread calling
+    :func:`sample_memory` every ``interval_s`` while telemetry is
+    enabled.  ``stop()`` joins it; usable as a context manager."""
+
+    def __init__(self, interval_s: float = 0.25):
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MemorySampler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="mem-sampler", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            sample_memory()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+# ---------------------------------------------------------------------------
 # Chrome trace-event export (Perfetto / chrome://tracing)
 # ---------------------------------------------------------------------------
 
-def export_chrome_trace(path: str,
-                        spans: Optional[Sequence[Span]] = None) -> int:
-    """Write the recorded spans as Chrome trace-event JSON (the
-    ``traceEvents`` object format, complete 'X' events with
-    microsecond ``ts``/``dur``) and return the event count.
-
-    Determinism: timestamps are rebased to the earliest span, thread
-    ids are remapped to dense integers in first-recorded order, and
-    ``pid`` is pinned — identical recordings (fixed clock, one thread)
-    export byte-identical files.  Written atomically."""
-    if spans is None:
-        spans = spans_snapshot()
-    spans = sorted(spans, key=lambda s: s.sid)
-    base = min((s.t0 for s in spans), default=0.0)
+def _process_events(spans: Sequence[Span], pid: int, base: float,
+                    process_name: str) -> List[Dict[str, Any]]:
+    """One process's Chrome events: process/thread 'M' metadata, 'X'
+    complete events for regular spans, and 'C' counter events (their
+    own Perfetto tracks) for ``cat='counter'`` samples — each numeric
+    attr of a counter span becomes one named counter series."""
     tid_map: Dict[int, int] = {}
-    events: List[Dict[str, Any]] = [{
-        "ph": "M", "name": "process_name", "pid": 1, "tid": 0,
-        "args": {"name": "cluster_tools_tpu"},
-    }]
     tnames: Dict[int, str] = {}
-    for s in spans:
+    for s in sorted(spans, key=lambda s: s.sid):
+        if s.cat == "counter":
+            continue
         if s.tid not in tid_map:
             tid_map[s.tid] = len(tid_map) + 1
             tnames[tid_map[s.tid]] = s.tname
+    events: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+        "args": {"name": process_name},
+    }]
     for tid in sorted(tnames):
-        events.append({"ph": "M", "name": "thread_name", "pid": 1,
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
                        "tid": tid, "args": {"name": tnames[tid]}})
     for s in sorted(spans, key=lambda s: (s.t0, s.sid)):
+        if s.cat == "counter":
+            for key in sorted(s.attrs):
+                val = s.attrs[key]
+                if isinstance(val, bool) or \
+                        not isinstance(val, (int, float)):
+                    continue
+                events.append({
+                    "ph": "C", "name": key, "pid": pid, "tid": 0,
+                    "ts": round((s.t0 - base) * 1e6, 3),
+                    "args": {"value": val},
+                })
+            continue
         args = dict(s.attrs)
         args["sid"] = s.sid
         if s.parent is not None:
             args["parent"] = s.parent
         events.append({
-            "ph": "X", "name": s.name, "cat": s.cat, "pid": 1,
+            "ph": "X", "name": s.name, "cat": s.cat, "pid": pid,
             "tid": tid_map[s.tid],
             "ts": round((s.t0 - base) * 1e6, 3),
             "dur": round(max(s.t1 - s.t0, 0.0) * 1e6, 3),
             "args": args,
         })
+    return events
+
+
+def _write_trace_events(path: str, events: List[Dict[str, Any]]) -> int:
     payload = {"traceEvents": events, "displayTimeUnit": "ms"}
     tmp = path + ".tmp%d" % os.getpid()
     with open(tmp, "w") as f:
@@ -404,6 +581,24 @@ def export_chrome_trace(path: str,
                   default=str)
     os.replace(tmp, path)
     return len(events)
+
+
+def export_chrome_trace(path: str,
+                        spans: Optional[Sequence[Span]] = None) -> int:
+    """Write the recorded spans as Chrome trace-event JSON (the
+    ``traceEvents`` object format, complete 'X' events with
+    microsecond ``ts``/``dur``, 'C' counter events for memory samples)
+    and return the event count.
+
+    Determinism: timestamps are rebased to the earliest span, thread
+    ids are remapped to dense integers in first-recorded order, and
+    ``pid`` is pinned — identical recordings (fixed clock, one thread)
+    export byte-identical files.  Written atomically."""
+    if spans is None:
+        spans = spans_snapshot()
+    base = min((s.t0 for s in spans), default=0.0)
+    events = _process_events(spans, 1, base, "cluster_tools_tpu")
+    return _write_trace_events(path, events)
 
 
 # ---------------------------------------------------------------------------
@@ -511,13 +706,74 @@ def queue_wait_histogram(bins: Sequence[float] = _DEFAULT_WAIT_BINS,
             "sum": round(float(sum(waits)), 6)}
 
 
-def summary(wall: Optional[float] = None) -> Dict[str, Any]:
-    """One-call rollup of the recorded trace: span counts by category,
-    per-stage second sums, device-busy (sum AND merged-timeline views),
-    bubble fraction, queue-wait histogram, ring drops.  ``wall`` (e.g.
-    the measured workflow wall) scopes the busy fraction; defaults to
-    the trace window."""
-    spans = spans_snapshot()
+#: counter-series / watermark-attr names whose max is the HOST memory
+#: peak, resp. the DEVICE memory peak (the two scalars diff_rollups
+#: gates on)
+_HOST_PEAK_SERIES = ("host_hwm_gb", "host_rss_gb",
+                     "mem_host_hwm_gb", "mem_host_rss_gb")
+_DEVICE_PEAK_SERIES = ("dev_peak_gb", "dev_in_use_gb",
+                      "mem_dev_peak_gb", "mem_dev_in_use_gb")
+
+
+def memory_rollup(spans: Optional[Sequence[Span]] = None
+                  ) -> Dict[str, Any]:
+    """Memory view of a trace: per-series counter stats (from
+    ``cat='counter'`` samples), per-span-name watermarks (from ``mem_*``
+    attrs the drain points stamp on block/slab/stage spans), and the two
+    peak scalars the trace-diff gate compares.  Peaks are None when the
+    trace carries no memory samples (pre-memory artifacts degrade to
+    "skip that check" in :func:`diff_rollups`)."""
+    if spans is None:
+        spans = spans_snapshot()
+    counters: Dict[str, Dict[str, Any]] = {}
+    watermarks: Dict[str, Dict[str, float]] = {}
+    for s in spans:
+        if s.cat == "counter":
+            for k, v in s.attrs.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                c = counters.setdefault(k, {"n": 0, "max": None,
+                                            "last": None})
+                c["n"] += 1
+                c["max"] = float(v) if c["max"] is None \
+                    else max(c["max"], float(v))
+                c["last"] = float(v)
+        else:
+            mem = {k: float(v) for k, v in s.attrs.items()
+                   if k.startswith("mem_")
+                   and not isinstance(v, bool)
+                   and isinstance(v, (int, float))}
+            if mem:
+                d = watermarks.setdefault(s.name, {})
+                for k, v in mem.items():
+                    d[k] = max(d.get(k, v), v)
+    peaks = {"host": None, "device": None}
+    for which, series in (("host", _HOST_PEAK_SERIES),
+                          ("device", _DEVICE_PEAK_SERIES)):
+        cands = [counters[k]["max"] for k in series if k in counters]
+        cands += [wm[k] for wm in watermarks.values()
+                  for k in series if k in wm]
+        if cands:
+            peaks[which] = round(max(cands), 4)
+    return {
+        "peak_host_rss_gb": peaks["host"],
+        "peak_device_gb": peaks["device"],
+        "counters": {k: {"n": c["n"],
+                         "max": round(c["max"], 4),
+                         "last": round(c["last"], 4)}
+                     for k, c in sorted(counters.items())},
+        "span_watermarks": {name: {k: round(v, 4)
+                                   for k, v in sorted(wm.items())}
+                            for name, wm in sorted(watermarks.items())},
+    }
+
+
+def rollup_spans(spans: Sequence[Span], wall: Optional[float] = None,
+                 dropped: int = 0) -> Dict[str, Any]:
+    """The rollup computation over an EXPLICIT span list — what
+    :func:`summary` applies to the live ring and
+    :func:`merge_chrome_traces` applies to a merged multi-process
+    trace."""
     window = trace_window(spans)
     if wall is None:
         wall = window
@@ -534,7 +790,7 @@ def summary(wall: Optional[float] = None) -> Dict[str, Any]:
     merged = sum(t1 - t0 for t0, t1 in busy_timeline(spans))
     return {
         "n_spans": len(spans),
-        "dropped": dropped_count(),
+        "dropped": dropped,
         "by_cat": dict(Counter(s.cat for s in spans)),
         "window_s": round(window, 4),
         "wall_s": round(wall, 4) if wall else None,
@@ -549,6 +805,129 @@ def summary(wall: Optional[float] = None) -> Dict[str, Any]:
         "pipeline_bubble_frac": (round(max(1.0 - merged / wall, 0.0), 4)
                                  if wall else None),
         "queue_wait": queue_wait_histogram(spans=spans),
+        "memory": memory_rollup(spans),
+    }
+
+
+def summary(wall: Optional[float] = None) -> Dict[str, Any]:
+    """One-call rollup of the recorded trace: span counts by category,
+    per-stage second sums, device-busy (sum AND merged-timeline views),
+    bubble fraction, queue-wait histogram, memory rollup, ring drops.
+    ``wall`` (e.g. the measured workflow wall) scopes the busy fraction;
+    defaults to the trace window."""
+    return rollup_spans(spans_snapshot(), wall=wall,
+                        dropped=dropped_count())
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace shards + merge
+# ---------------------------------------------------------------------------
+
+def _span_to_dict(s: Span) -> Dict[str, Any]:
+    return {"sid": s.sid, "parent": s.parent, "name": s.name,
+            "cat": s.cat, "t0": s.t0, "t1": s.t1, "tid": s.tid,
+            "tname": s.tname, "attrs": s.attrs}
+
+
+def _span_from_dict(d: Dict[str, Any]) -> Span:
+    return Span(int(d["sid"]), d.get("parent"), d["name"], d["cat"],
+                float(d["t0"]), float(d["t1"]), int(d.get("tid", 0)),
+                d.get("tname", ""), dict(d.get("attrs") or {}))
+
+
+def export_trace_shard(path: str, process_index: int = 0,
+                       process_count: int = 1,
+                       wall_anchor: Optional[float] = None,
+                       perf_anchor: Optional[float] = None,
+                       spans: Optional[Sequence[Span]] = None) -> int:
+    """Write one process's RAW spans plus its clock anchors as a trace
+    SHARD (JSON).  The recorder clock (``perf_counter``) is not
+    comparable across processes; the (wall, perf) anchor pair — taken
+    barrier-aligned by ``multihost.clock_anchor`` — lets
+    :func:`merge_chrome_traces` rebase every shard onto one shared
+    timeline.  Returns the span count; written atomically."""
+    if spans is None:
+        spans = spans_snapshot()
+    if wall_anchor is None:
+        wall_anchor = time.time()
+    if perf_anchor is None:
+        perf_anchor = _REC.clock()
+    payload = {
+        "process_index": int(process_index),
+        "process_count": int(process_count),
+        "wall_anchor": float(wall_anchor),
+        "perf_anchor": float(perf_anchor),
+        "dropped": dropped_count(),
+        "spans": [_span_to_dict(s) for s in spans],
+    }
+    tmp = path + ".tmp%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(payload, f, sort_keys=True, separators=(",", ":"),
+                  default=str)
+    os.replace(tmp, path)
+    return len(payload["spans"])
+
+
+def load_trace_shard(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def merge_chrome_traces(shard_paths: Sequence[str], out_path: str,
+                        wall: Optional[float] = None) -> Dict[str, Any]:
+    """Merge per-process trace shards into ONE Perfetto-loadable Chrome
+    trace and one cross-mesh rollup.
+
+    Each shard's spans are rebased onto a shared timeline:
+    ``t' = (t - perf_anchor_i) + (wall_anchor_i - min_j wall_anchor_j)``
+    — the file-handshake wall anchors estimate per-process clock offset,
+    the perf anchors remove each process's arbitrary monotonic origin.
+    Process ``i`` becomes Perfetto pid ``process_index + 1`` (the
+    single-process exporter's pinned ``pid=1`` collides across shards).
+    The merged span list feeds the SAME rollups as a single-process
+    trace, so ``device_busy_s``/bubble fraction aggregate across the
+    mesh; per-process ``device_busy_s`` is returned for cross-checks."""
+    shards = [load_trace_shard(p) for p in shard_paths]
+    if not shards:
+        raise ValueError("merge_chrome_traces: no shards")
+    shards.sort(key=lambda sh: int(sh.get("process_index", 0)))
+    wall0 = min(float(sh.get("wall_anchor", 0.0)) for sh in shards)
+    rebased: List[Tuple[int, List[Span]]] = []
+    for sh in shards:
+        pidx = int(sh.get("process_index", 0))
+        off = (float(sh.get("wall_anchor", 0.0)) - wall0) \
+            - float(sh.get("perf_anchor", 0.0))
+        spans = [
+            Span(s.sid, s.parent, s.name, s.cat, s.t0 + off, s.t1 + off,
+                 s.tid, s.tname, s.attrs)
+            for s in (_span_from_dict(d) for d in sh.get("spans") or [])
+        ]
+        rebased.append((pidx, spans))
+    all_spans = [s for _, spans in rebased for s in spans]
+    base = min((s.t0 for s in all_spans), default=0.0)
+    events: List[Dict[str, Any]] = []
+    processes: List[Dict[str, Any]] = []
+    for (pidx, spans), sh in zip(rebased, shards):
+        pid = pidx + 1
+        events.extend(_process_events(spans, pid, base,
+                                      f"cluster_tools_tpu p{pidx}"))
+        processes.append({
+            "process_index": pidx,
+            "pid": pid,
+            "n_spans": len(spans),
+            "dropped": int(sh.get("dropped", 0)),
+            "device_busy_s": round(device_busy_seconds(spans), 4),
+            "clock_offset_s": round(
+                float(sh.get("wall_anchor", 0.0)) - wall0, 6),
+        })
+    n_events = _write_trace_events(out_path, events)
+    rollups = rollup_spans(all_spans, wall=wall,
+                           dropped=sum(p["dropped"] for p in processes))
+    return {
+        "n_events": n_events,
+        "n_processes": len(processes),
+        "processes": processes,
+        "rollups": rollups,
     }
 
 
@@ -672,26 +1051,41 @@ def histogram_family(name: str, help_text: str,
 
 def diff_rollups(a: Dict[str, Any], b: Dict[str, Any], *,
                  rel_threshold: float = 0.2, abs_floor_s: float = 0.05,
-                 bubble_abs: float = 0.05) -> Dict[str, Any]:
+                 bubble_abs: float = 0.05,
+                 mem_abs_floor_gb: float = 0.25) -> Dict[str, Any]:
     """Compare two span rollups (``summary()`` dicts, or the ``rollups``
     section of a TRACE artifact): per-stage seconds, total device-busy
-    seconds, and the pipeline-bubble fraction.
+    seconds, the pipeline-bubble fraction, and the memory peaks.
 
     A quantity REGRESSES when the candidate ``b`` exceeds the baseline
     ``a`` by more than ``max(abs_floor_s, rel_threshold * a)`` (the abs
     floor keeps microsecond stages from tripping the relative gate on
-    noise).  Device-path stages and the device-busy total GATE (they are
-    what ROADMAP item 5 steers on); host/store stage regressions are
-    reported as warnings only, because host time is the thing device
-    optimizations deliberately trade against.  ``bench.py trace-diff``
-    exits nonzero iff ``regressed``."""
+    noise).  Device-path stages, the device-busy total, and the memory
+    peaks (``peak_host_rss_gb``/``peak_device_gb``, against
+    ``max(mem_abs_floor_gb, rel_threshold * a)``) GATE; host/store stage
+    regressions are reported as warnings only, because host time is the
+    thing device optimizations deliberately trade against.  A baseline
+    or candidate WITHOUT a memory section (pre-memory artifacts,
+    malformed rollups) degrades to skipping that memory check — never a
+    crash, never a false regression.  ``bench.py trace-diff`` exits
+    nonzero iff ``regressed``."""
     sa = a.get("stage_seconds") or {}
     sb = b.get("stage_seconds") or {}
+    if not isinstance(sa, dict):
+        sa = {}
+    if not isinstance(sb, dict):
+        sb = {}
     stages: Dict[str, Dict[str, Any]] = {}
     regressions: List[str] = []
     warnings: List[str] = []
+    def _stage_val(stages_doc, name):
+        try:
+            return float(stages_doc.get(name, 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
     for name in sorted(set(sa) | set(sb)):
-        av, bv = float(sa.get(name, 0.0)), float(sb.get(name, 0.0))
+        av, bv = _stage_val(sa, name), _stage_val(sb, name)
         delta = bv - av
         worse = delta > max(abs_floor_s, rel_threshold * av)
         device = name.startswith(DEVICE_STAGE_PREFIXES)
@@ -703,22 +1097,56 @@ def diff_rollups(a: Dict[str, Any], b: Dict[str, Any], *,
         }
         if worse:
             (regressions if device else warnings).append(f"stage:{name}")
-    busy_a = float(a.get("device_busy_s", 0.0))
-    busy_b = float(b.get("device_busy_s", 0.0))
+    def _num(doc, key, default=None):
+        try:
+            v = doc.get(key, default)
+            return default if v is None else float(v)
+        except (TypeError, ValueError):
+            return default
+
+    busy_a = _num(a, "device_busy_s", 0.0)
+    busy_b = _num(b, "device_busy_s", 0.0)
     busy_delta = busy_b - busy_a
     busy_worse = busy_delta > max(abs_floor_s, rel_threshold * busy_a)
     if busy_worse:
         regressions.append("device_busy_s")
-    bub_a = a.get("pipeline_bubble_frac")
-    bub_b = b.get("pipeline_bubble_frac")
+    bub_a = _num(a, "pipeline_bubble_frac")
+    bub_b = _num(b, "pipeline_bubble_frac")
     bub_delta = (None if bub_a is None or bub_b is None
-                 else float(bub_b) - float(bub_a))
+                 else bub_b - bub_a)
     bub_worse = bub_delta is not None and bub_delta > bubble_abs
     if bub_worse:
         regressions.append("pipeline_bubble_frac")
+    ma = a.get("memory")
+    mb = b.get("memory")
+    if not isinstance(ma, dict):
+        ma = {}
+    if not isinstance(mb, dict):
+        mb = {}
+    memory: Dict[str, Dict[str, Any]] = {}
+    for key in ("peak_host_rss_gb", "peak_device_gb"):
+        av, bv = ma.get(key), mb.get(key)
+        try:
+            av = None if av is None else float(av)
+            bv = None if bv is None else float(bv)
+        except (TypeError, ValueError):
+            av = bv = None
+        if av is None or bv is None:
+            # pre-memory baseline (or candidate without samples):
+            # degrade to "skip this check", never crash the gate
+            memory[key] = {"skipped": True, "a_gb": av, "b_gb": bv,
+                           "regressed": False}
+            continue
+        delta = bv - av
+        worse = delta > max(mem_abs_floor_gb, rel_threshold * av)
+        memory[key] = {"a_gb": round(av, 4), "b_gb": round(bv, 4),
+                       "delta_gb": round(delta, 4), "regressed": worse}
+        if worse:
+            regressions.append(f"memory:{key}")
     return {
         "thresholds": {"rel": rel_threshold, "abs_floor_s": abs_floor_s,
-                       "bubble_abs": bubble_abs},
+                       "bubble_abs": bubble_abs,
+                       "mem_abs_floor_gb": mem_abs_floor_gb},
         "stages": stages,
         "device_busy": {"a_s": round(busy_a, 4), "b_s": round(busy_b, 4),
                         "delta_s": round(busy_delta, 4),
@@ -727,10 +1155,121 @@ def diff_rollups(a: Dict[str, Any], b: Dict[str, Any], *,
                    "delta": (round(bub_delta, 4)
                              if bub_delta is not None else None),
                    "regressed": bub_worse},
+        "memory": memory,
         "regressions": regressions,
         "warnings": warnings,
         "regressed": bool(regressions),
     }
+
+
+# ---------------------------------------------------------------------------
+# crash flight recorder
+# ---------------------------------------------------------------------------
+
+_FLIGHT_LOCK = threading.Lock()
+_FLIGHT_COUNT = 0
+_FLIGHT_SEQ = itertools.count(1)
+_FLIGHT_SLUG_RE = re.compile(r"[^A-Za-z0-9_-]+")
+
+
+def flight_record(directory: str, reason: str,
+                  extra: Optional[Dict[str, Any]] = None,
+                  max_spans: int = 4096) -> str:
+    """Dump a postmortem snapshot — the span ring buffer, the memory
+    timeline/rollup plus a live probe reading, and caller-supplied state
+    (the server passes queue depth, SLO report and in-flight request
+    correlation ids) — to an atomic ``flightrec_*.json`` in
+    ``directory``.  Called on unhandled exceptions, tenant faults and
+    SIGTERM (see :func:`install_flight_recorder`); works with telemetry
+    disabled (the span list is just empty).  Returns the file path."""
+    global _FLIGHT_COUNT
+    os.makedirs(directory, exist_ok=True)
+    spans = spans_snapshot()[-int(max_spans):]
+    try:
+        from ..parallel import multihost
+        pidx, pcnt = multihost.process_index(), multihost.process_count()
+    except Exception:
+        pidx, pcnt = 0, 1
+    payload = {
+        "reason": str(reason),
+        "unix_time": time.time(),
+        "host_pid": os.getpid(),
+        "process_index": pidx,
+        "process_count": pcnt,
+        "dropped_spans": dropped_count(),
+        "n_spans": len(spans),
+        "memory": {
+            "probe": {"host": host_memory_bytes(),
+                      "device": device_memory_bytes()},
+            "rollup": memory_rollup(spans),
+        },
+        "spans": [_span_to_dict(s) for s in spans],
+        "extra": dict(extra or {}),
+    }
+    slug = _FLIGHT_SLUG_RE.sub("-", str(reason)).strip("-")[:48] \
+        or "unknown"
+    with _FLIGHT_LOCK:
+        seq = next(_FLIGHT_SEQ)
+        _FLIGHT_COUNT += 1
+    path = os.path.join(directory,
+                        f"flightrec_{slug}_{os.getpid()}_{seq}.json")
+    tmp = path + ".tmp%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(payload, f, sort_keys=True, separators=(",", ":"),
+                  default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def flight_record_count() -> int:
+    return _FLIGHT_COUNT
+
+
+def install_flight_recorder(directory: str,
+                            extra_fn: Optional[Callable[[], Dict]] = None,
+                            sigterm: bool = False) -> Callable[[], None]:
+    """OPT-IN process-level crash hooks: wrap ``sys.excepthook`` (and,
+    when ``sigterm=True``, the SIGTERM handler) so an unhandled crash or
+    a kill leaves a flight-recorder dump before the process dies.  The
+    previous hooks are chained, not replaced; returns an ``uninstall``
+    callable restoring them (tests stay hermetic)."""
+    import signal
+    import sys
+
+    prev_hook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        try:
+            flight_record(directory, "exception", extra={
+                "exc_type": getattr(exc_type, "__name__", str(exc_type)),
+                "exc": str(exc),
+                **((extra_fn() or {}) if extra_fn else {}),
+            })
+        except Exception:
+            pass
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+    prev_sig = None
+    if sigterm:
+        def _on_term(signum, frame):
+            try:
+                flight_record(directory, "sigterm",
+                              extra=(extra_fn() or {}) if extra_fn
+                              else {})
+            except Exception:
+                pass
+            signal.signal(signal.SIGTERM, prev_sig or signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        prev_sig = signal.signal(signal.SIGTERM, _on_term)
+
+    def uninstall():
+        sys.excepthook = prev_hook
+        if sigterm:
+            signal.signal(signal.SIGTERM, prev_sig or signal.SIG_DFL)
+
+    return uninstall
 
 
 # ---------------------------------------------------------------------------
@@ -785,14 +1324,31 @@ def metrics_families():
     with _REC.lock:
         n_spans = len(_REC.spans)
         dropped = _REC.dropped
-    return [
+    fams = [
         ("ctt_telemetry_dropped_spans_total", "counter",
          "Spans evicted from the bounded telemetry ring",
          [(None, dropped)]),
         ("ctt_telemetry_ring_spans", "gauge",
          "Spans currently held in the telemetry ring",
          [(None, n_spans)]),
+        ("ctt_telemetry_flight_records_total", "counter",
+         "Flight-recorder postmortem dumps written by this process",
+         [(None, _FLIGHT_COUNT)]),
     ]
+    host = host_memory_bytes()
+    fams.append(
+        ("ctt_memory_host_gb", "gauge",
+         "Host memory (GiB, 1024-based): resident set and high-water",
+         [({"kind": "rss"}, round(host["rss"] / _GIB, 4)),
+          ({"kind": "hwm"}, round(host["hwm"] / _GIB, 4))]))
+    dev = device_memory_bytes()
+    if dev is not None:
+        fams.append(
+            ("ctt_memory_device_gb", "gauge",
+             "Device memory (GiB) from device.memory_stats()",
+             [({"kind": "in_use"}, round(dev["in_use"] / _GIB, 4)),
+              ({"kind": "peak"}, round(dev["peak"] / _GIB, 4))]))
+    return fams
 
 
 # ---------------------------------------------------------------------------

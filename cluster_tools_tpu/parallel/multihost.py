@@ -214,6 +214,54 @@ def fs_barrier(tmp_folder: str, name: str,
         time.sleep(poll)
 
 
+def clock_anchor(tmp_folder: str, name: str = "trace-anchor",
+                 timeout: Optional[float] = 600.0):
+    """Barrier-aligned ``(wall, perf)`` clock sample for trace-shard
+    merging.  Every process leaves the same :func:`fs_barrier` round
+    within one poll interval, so the wall-clock values taken immediately
+    after release estimate the cross-process clock offset to ~the poll
+    granularity — the file-handshake analog of an NTP exchange, reusing
+    the ``epoch_p{i}`` machinery instead of a network round-trip."""
+    from ..core import telemetry
+
+    fs_barrier(tmp_folder, name, timeout=timeout)
+    return (time.time(), telemetry.now())
+
+
+def trace_shard_path(tmp_folder: str, pid: Optional[int] = None) -> str:
+    """Canonical per-process trace-shard path under ``tmp_folder``."""
+    p = process_index() if pid is None else int(pid)
+    return os.path.join(tmp_folder, f"trace_shard_p{p}.json")
+
+
+def export_trace_shard(tmp_folder: str, anchor=None) -> str:
+    """Export this process's span ring as ``trace_shard_p{i}.json`` in
+    the shared tmp folder.  ``anchor`` is an optional barrier-aligned
+    ``(wall, perf)`` pair from :func:`clock_anchor`; without one the
+    shard anchors to its own clocks (offset estimate degrades to
+    whatever the hosts' wall clocks agree on)."""
+    from ..core import telemetry
+
+    path = trace_shard_path(tmp_folder)
+    wall, perf = anchor if anchor is not None else (None, None)
+    telemetry.export_trace_shard(
+        path, process_index=process_index(),
+        process_count=process_count(),
+        wall_anchor=wall, perf_anchor=perf)
+    return path
+
+
+def merge_trace_shards(tmp_folder: str, out_path: str, wall=None):
+    """Lead-side merge of every process's shard (call after a barrier so
+    all shards exist).  Returns the merge summary from
+    :func:`core.telemetry.merge_chrome_traces`."""
+    from ..core import telemetry
+
+    shards = [trace_shard_path(tmp_folder, p)
+              for p in range(process_count())]
+    return telemetry.merge_chrome_traces(shards, out_path, wall=wall)
+
+
 def make_multihost_mesh(axis_names: Sequence[str] = ("data", "model"),
                         dcn_axis: int = 0):
     """Mesh spanning all hosts: the ``dcn_axis`` runs across processes

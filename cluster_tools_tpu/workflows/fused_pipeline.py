@@ -139,8 +139,31 @@ def raw_cache_get(path: str, key: str):
 
 
 def clear_caches() -> None:
+    from ..core import runtime as rt
     _FRAGMENT_CACHE.clear()
     _RAW_CACHE.clear()
+    rt.ledger_clear("fragment_cache")
+    rt.ledger_clear("raw_cache")
+
+
+def _fragment_cache_put(key, local, off, bb) -> None:
+    """Insert into the fragment cache, keeping the live-buffer ledger in
+    sync (overwrites release the previous entry's bytes first)."""
+    from ..core import runtime as rt
+    prev = _FRAGMENT_CACHE.get(key)
+    _FRAGMENT_CACHE[key] = (local, int(off), bb)
+    rt.ledger_add("fragment_cache",
+                  int(local.nbytes) - (int(prev[0].nbytes) if prev else 0),
+                  0 if prev else 1)
+
+
+def _raw_cache_put(key, vol, is_u8) -> None:
+    from ..core import runtime as rt
+    prev = _RAW_CACHE.get(key)
+    _RAW_CACHE[key] = (vol, is_u8)
+    rt.ledger_add("raw_cache",
+                  int(vol.nbytes) - (int(prev[0].nbytes) if prev else 0),
+                  0 if prev else 1)
 
 
 @lru_cache(maxsize=8)
@@ -926,8 +949,8 @@ class FusedSegmentationBlocks(BlockTask):
                        "invert": bool(cfg.get("invert_inputs", False))}, fo)
         if not is_u8:
             vol = _normalize_input(vol.astype("float32"), cfg)
-        _RAW_CACHE[(os.path.abspath(cfg["input_path"]),
-                    cfg["input_key"])] = (vol, is_u8)
+        _raw_cache_put((os.path.abspath(cfg["input_path"]),
+                        cfg["input_key"]), vol, is_u8)
         from .watershed import reflect_indices
 
         gdims = [-(-s // b) for s, b in zip(shape, bs)]
@@ -998,8 +1021,7 @@ class FusedSegmentationBlocks(BlockTask):
             and blocks write disjoint chunk-aligned regions."""
             local = dense_np[real]
             local = local.astype("uint16" if k_i < 65536 else "uint32")
-            _FRAGMENT_CACHE[ws_cache_key + (bid,)] = (local, int(off),
-                                                      block.bb)
+            _fragment_cache_put(ws_cache_key + (bid,), local, off, block.bb)
             out = local.astype("uint64")
             out[out > 0] += off
             _write(block.bb, out)
@@ -1039,8 +1061,10 @@ class FusedSegmentationBlocks(BlockTask):
             if retried or not telemetry.enabled():
                 return _drain_body(entry, retried)
             with telemetry.span(f"block:{entry[0]}", cat="block",
-                                block=entry[0]):
-                return _drain_body(entry, retried)
+                                block=entry[0]) as sp:
+                out = _drain_body(entry, retried)
+                telemetry.annotate_memory(sp)
+                return out
 
         def _drain_body(entry, retried: bool = False):
             bid, handles = entry
@@ -1213,8 +1237,8 @@ class FusedSegmentationBlocks(BlockTask):
                       fo)
         if not is_u8:
             vol = _normalize_input(vol.astype("float32"), cfg)
-        _RAW_CACHE[(os.path.abspath(cfg["input_path"]),
-                    cfg["input_key"])] = (vol, is_u8)
+        _raw_cache_put((os.path.abspath(cfg["input_path"]),
+                        cfg["input_key"]), vol, is_u8)
 
         # equalize the shards: pad z to n_shards * slab_z by VOLUME-level
         # reflection (the same fold as the blockwise readers; the padded
@@ -1321,8 +1345,8 @@ class FusedSegmentationBlocks(BlockTask):
             local = np.where(sl > 0, sl.astype("int64") - off, 0)
             local = local.astype("uint16" if k_i < 65536
                                  else "uint32")
-            _FRAGMENT_CACHE[ws_cache_key + (sid,)] = (local, off,
-                                                      block.bb)
+            _fragment_cache_put(ws_cache_key + (sid,), local, off,
+                                block.bb)
             pool.submit(_write, block.bb, sl.astype("uint64"))
             n_r = int(meta[sid, 1])
             uv_np = uv_all[sid, :n_r].astype("uint64")
@@ -1349,8 +1373,9 @@ class FusedSegmentationBlocks(BlockTask):
         with writer_pool(cfg, ds_out) as pool:
             for sid in range(blocking.n_blocks):
                 with telemetry.span(f"slab:{sid}", cat="block",
-                                    block=sid):
+                                    block=sid) as sp:
                     _drain_slab(sid, pool)
+                    telemetry.annotate_memory(sp)
         state["offset"] = np.uint64(offs[-1])
 
     @classmethod

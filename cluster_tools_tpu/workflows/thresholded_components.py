@@ -211,7 +211,7 @@ class ResidentBlockComponents(BlockTask):
         from ..core.runtime import (stage, stage_add, stage_bytes,
                                     stream_window)
         from ..ops.sweep import rle_decode_packed
-        from .fused_pipeline import _FRAGMENT_CACHE
+        from .fused_pipeline import _fragment_cache_put
 
         cfg = job_config["config"]
         blocking = Blocking(cfg["shape"], cfg["block_shape"])
@@ -319,7 +319,7 @@ class ResidentBlockComponents(BlockTask):
                 stage_bytes("d2h-dense", dense_np.nbytes)
             local = dense_np[real]
             local = local.astype("uint16" if k_i < 65536 else "uint32")
-            _FRAGMENT_CACHE[cache_key + (bid,)] = (local, 0, block.bb)
+            _fragment_cache_put(cache_key + (bid,), local, 0, block.bb)
             write_futures.append(
                 writer.submit(_write, block.bb, local.astype("uint64")))
             max_ids[bid] = k_i

@@ -462,3 +462,96 @@ def test_global_config_activates_disk_tier(tmp_path):
         assert runtime._exec_cache_max_bytes() == 123456
     finally:
         runtime._DISK_TIER.update(saved)
+
+
+# ---------------------------------------------------------------------------
+# live-buffer ledger (ISSUE 17 tentpole b): bytes pinned by warm caches,
+# exported through metrics_families and status JSONs
+# ---------------------------------------------------------------------------
+
+def test_ledger_accounting_basics():
+    runtime.ledger_clear()
+    try:
+        runtime.ledger_add("fragment_cache", 100, 1)
+        runtime.ledger_add("fragment_cache", 50, 1)
+        runtime.ledger_set("raw_cache", 2048, 1)
+        snap = runtime.ledger_snapshot()
+        assert snap["fragment_cache"] == {"bytes": 150, "entries": 2}
+        assert snap["raw_cache"] == {"bytes": 2048, "entries": 1}
+        # releases clamp at zero — an over-release is a bookkeeping bug,
+        # not a reason to report negative resident bytes
+        runtime.ledger_add("fragment_cache", -500, -5)
+        assert runtime.ledger_snapshot()["fragment_cache"] \
+            == {"bytes": 0, "entries": 0}
+        # snapshots are copies: mutating one never corrupts the ledger
+        snap["raw_cache"]["bytes"] = -1
+        assert runtime.ledger_snapshot()["raw_cache"]["bytes"] == 2048
+        runtime.ledger_clear("raw_cache")
+        assert "raw_cache" not in runtime.ledger_snapshot()
+    finally:
+        runtime.ledger_clear()
+
+
+def test_ledger_metrics_families():
+    runtime.ledger_clear()
+    try:
+        runtime.ledger_set("exec_cache", 4096, 2)
+        fams = {f[0]: f for f in runtime.metrics_families()}
+        assert fams["ctt_ledger_bytes"][3] \
+            == [({"account": "exec_cache"}, 4096)]
+        assert fams["ctt_ledger_entries"][3] \
+            == [({"account": "exec_cache"}, 2)]
+        runtime.ledger_clear()
+        fams = {f[0]: f for f in runtime.metrics_families()}
+        assert fams["ctt_ledger_bytes"][3] == [(None, 0)]
+    finally:
+        runtime.ledger_clear()
+
+
+def test_exec_cache_ledger_tracks_blob_bytes(exec_disk):
+    """compile_cached accounts the serialized blob's size under the
+    'exec_cache' ledger account — on the build path AND the disk-hit
+    path — and exec_cache_clear releases it."""
+    _needs_serialization()
+    runtime.ledger_clear()
+    runtime.compile_cached(("triv", 3.0), _trivial_compiled)
+    blob = [f for f in os.listdir(exec_disk) if f.endswith(".jexec")][0]
+    nbytes = os.path.getsize(os.path.join(exec_disk, blob))
+    assert nbytes > 0
+    led = runtime.ledger_snapshot()["exec_cache"]
+    assert led == {"bytes": nbytes, "entries": 1}
+    runtime.exec_cache_clear()
+    assert "exec_cache" not in runtime.ledger_snapshot()
+    # warm re-load from disk re-pins the same footprint
+    runtime.compile_cached(("triv", 3.0), _trivial_compiled)
+    assert runtime.EXEC_CACHE_STATS["disk_hits"] == 1
+    assert runtime.ledger_snapshot()["exec_cache"] \
+        == {"bytes": nbytes, "entries": 1}
+
+
+def test_fragment_cache_puts_feed_ledger():
+    """The fused pipeline's cache-put helpers keep the ledger in sync,
+    overwrites included, and clear_caches releases everything."""
+    from cluster_tools_tpu.workflows import fused_pipeline as fp
+
+    fp.clear_caches()
+    try:
+        fp._fragment_cache_put(("p", "k", 0), np.zeros(10, "uint16"),
+                               0, ((0, 1),))
+        fp._fragment_cache_put(("p", "k", 1), np.zeros(5, "uint16"),
+                               0, ((0, 1),))
+        fp._raw_cache_put(("p", "k"), np.zeros(7, "uint8"), False)
+        snap = runtime.ledger_snapshot()
+        assert snap["fragment_cache"] == {"bytes": 30, "entries": 2}
+        assert snap["raw_cache"] == {"bytes": 7, "entries": 1}
+        # overwriting a key releases the old entry's bytes first
+        fp._fragment_cache_put(("p", "k", 0), np.zeros(20, "uint16"),
+                               0, ((0, 1),))
+        assert runtime.ledger_snapshot()["fragment_cache"] \
+            == {"bytes": 50, "entries": 2}
+        assert fp._FRAGMENT_CACHE[("p", "k", 0)][0].nbytes == 40
+        fp.clear_caches()
+        snap = runtime.ledger_snapshot()
+        assert "fragment_cache" not in snap and "raw_cache" not in snap
+    finally:
+        fp.clear_caches()

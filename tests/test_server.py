@@ -110,6 +110,65 @@ def test_tenant_fault_isolation(tmp_path):
         assert json.load(f)["state"] == "failed"
 
 
+def test_tenant_fault_writes_flight_record(tmp_path):
+    """An injected tenant fault leaves a flight-recorder dump in the
+    server workdir carrying the failing request's correlation id, the
+    error, and the queue state at fault time (ISSUE 17 tentpole d)."""
+    import glob
+    import os
+
+    pipe = StubPipeline(n_blocks=2, fail_tag="BAD")
+    srv = ResidentSegmentationServer(str(tmp_path), pipe)
+    hb = srv.submit("mallory", "BAD")
+    ha = srv.submit("alice", "A")
+    srv.start()
+    srv.shutdown(drain=True)
+    with pytest.raises(RuntimeError, match="injected failure"):
+        hb.result(1)
+    assert ha.result(1)["n_segments"] == 1
+    recs = glob.glob(os.path.join(str(tmp_path), "flightrec_*.json"))
+    assert len(recs) == 1, recs
+    with open(recs[0]) as f:
+        doc = json.load(f)
+    assert doc["reason"] == f"tenant-fault:{hb.request_id}"
+    assert doc["extra"]["request"] == hb.request_id
+    assert doc["extra"]["tenant"] == "mallory"
+    assert "injected failure" in doc["extra"]["error"]
+    assert doc["extra"]["n_blocks"] == 2
+    assert isinstance(doc["extra"]["pending_requests"], list)
+    assert doc["memory"]["probe"]["host"]["rss"] > 0
+    # healthy requests leave no dumps behind
+    srv2 = ResidentSegmentationServer(str(tmp_path / "ok"), StubPipeline())
+    h = srv2.submit("alice", "A")
+    srv2.start()
+    srv2.shutdown(drain=True)
+    h.result(1)
+    assert not glob.glob(os.path.join(str(tmp_path / "ok"),
+                                      "flightrec_*.json"))
+
+
+def test_status_json_carries_ledger(tmp_path):
+    """Per-request status JSONs record the live-buffer ledger next to
+    stage_counts/exec_cache (ISSUE 17 tentpole b)."""
+    from cluster_tools_tpu.core import runtime as rt
+
+    rt.ledger_clear()
+    rt.ledger_set("exec_cache", 1024, 1)
+    try:
+        pipe = StubPipeline(n_blocks=1)
+        srv = ResidentSegmentationServer(str(tmp_path), pipe)
+        h = srv.submit("alice", "A")
+        srv.start()
+        srv.shutdown(drain=True)
+        h.result(1)
+        with open(h.status_path) as f:
+            status = json.load(f)
+        assert status["ledger"]["exec_cache"] == {"bytes": 1024,
+                                                  "entries": 1}
+    finally:
+        rt.ledger_clear()
+
+
 def test_shutdown_cancels_queue_without_drain(tmp_path):
     """shutdown(drain=False) cancels queued-but-unstarted requests and
     records them as cancelled; their callers get the error, not a hang."""

@@ -839,3 +839,308 @@ def test_telemetry_off_overhead_under_one_percent():
         f"telemetry-off overhead projection {projected:.6f}s exceeds 1% "
         f"of the {wall_off}s flagship wall ({per_call * 1e9:.0f} ns/call "
         f"x {n_entries} entries)")
+
+# ---------------------------------------------------------------------------
+# memory observability (ISSUE 17 tentpole a: probe, counter tracks,
+# per-span watermarks, memory rollup)
+# ---------------------------------------------------------------------------
+
+def test_host_memory_probe_reads_proc_status():
+    """The probe reads real, positive RSS/HWM bytes and the shared
+    peak-RSS helper uses the 1024-based conversion (the old ad-hoc
+    ``ru_maxrss / 1e6`` it replaces OVERSTATES GiB, so the bench's
+    ``< 7 GB`` bound only got safer)."""
+    import resource
+
+    mem = telemetry.host_memory_bytes()
+    assert mem["rss"] > 0 and mem["hwm"] > 0
+    gib = telemetry.host_peak_rss_gb()
+    assert gib == pytest.approx(mem["hwm"] / 1024.0 ** 3)
+    old_style = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    assert gib < old_style + 1e-9
+
+
+def test_device_memory_probe_graceful_without_allocator_stats():
+    """CPU jaxlib exposes no allocator stats: the device probe returns
+    None (never raises) and NEVER imports jax as a side effect."""
+    dev = telemetry.device_memory_bytes()
+    assert dev is None or (dev["in_use"] >= 0 and
+                           dev["peak"] >= dev["in_use"])
+
+
+def test_sample_memory_exports_counter_tracks(fake_clock, tmp_path):
+    """Counter samples export as Chrome 'C' events (one Perfetto counter
+    track per series) and stay OFF the thread-metadata tracks."""
+    telemetry.sample_memory()
+    with telemetry.span("block:0", cat="block", block=0):
+        pass
+    path = str(tmp_path / "trace.json")
+    telemetry.export_chrome_trace(path, telemetry.spans_snapshot())
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    counters = [e for e in events if e["ph"] == "C"]
+    assert {e["name"] for e in counters} >= {"host_rss_gb",
+                                            "host_hwm_gb"}
+    for e in counters:
+        assert set(e["args"]) == {"value"}
+        assert e["args"]["value"] > 0
+    # the counter pseudo-track claims no thread-name metadata
+    thread_meta_tids = {e["tid"] for e in events
+                       if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert not any(e["tid"] in thread_meta_tids for e in counters)
+    # the block span still exports as a normal 'X' slice
+    assert any(e["ph"] == "X" and e["name"] == "block:0"
+               for e in events)
+
+
+def test_sample_memory_disabled_is_noop():
+    assert not telemetry.enabled()
+    assert telemetry.sample_memory() is None
+    telemetry.annotate_memory(telemetry.span("x"))   # null span: no-op
+    assert telemetry.spans_snapshot() == []
+
+
+def test_annotate_memory_stamps_span_watermarks(fake_clock):
+    """Drain-point hook: mem_* attrs land on the open span and the
+    rollup folds them into per-span-name watermarks + the peak scalars
+    the trace-diff gate compares."""
+    with telemetry.span("block:3", cat="block", block=3) as sp:
+        telemetry.annotate_memory(sp)
+    roll = telemetry.memory_rollup()
+    wm = roll["span_watermarks"]["block:3"]
+    assert wm["mem_host_rss_gb"] > 0
+    assert wm["mem_host_hwm_gb"] > 0
+    assert roll["peak_host_rss_gb"] >= wm["mem_host_rss_gb"]
+    assert roll["counters"]["host_rss_gb"]["n"] == 1
+    # summary() embeds the same rollup (bench artifacts record it)
+    assert telemetry.summary()["memory"]["peak_host_rss_gb"] \
+        == roll["peak_host_rss_gb"]
+
+
+def test_memory_rollup_empty_trace_has_null_peaks():
+    """A trace with no memory samples yields None peaks — the
+    degrade-to-skip contract diff_rollups depends on."""
+    roll = telemetry.memory_rollup([])
+    assert roll["peak_host_rss_gb"] is None
+    assert roll["peak_device_gb"] is None
+    assert roll["counters"] == {} and roll["span_watermarks"] == {}
+
+
+def test_memory_sampler_background_thread(fake_clock):
+    """The optional background probe records counter samples while
+    running and stops cleanly."""
+    with telemetry.MemorySampler(interval_s=0.005):
+        deadline = time.time() + 2.0
+        while telemetry.memory_rollup()["counters"].get(
+                "host_rss_gb", {}).get("n", 0) < 2:
+            assert time.time() < deadline, "sampler recorded nothing"
+            time.sleep(0.005)
+    n = telemetry.memory_rollup()["counters"]["host_rss_gb"]["n"]
+    time.sleep(0.02)      # stopped: no further samples
+    assert telemetry.memory_rollup()["counters"]["host_rss_gb"]["n"] == n
+
+
+# ---------------------------------------------------------------------------
+# trace-diff memory gate + malformed/partial artifacts (satellite 3)
+# ---------------------------------------------------------------------------
+
+_MEM_ROLLUPS = {**_BASE_ROLLUPS,
+                "memory": {"peak_host_rss_gb": 4.0,
+                           "peak_device_gb": 2.0}}
+
+
+def test_diff_rollups_memory_regression_gates():
+    """A synthetic peak-HBM regression fails the gate exactly like a
+    device-busy regression (acceptance criterion)."""
+    cand = {**_MEM_ROLLUPS,
+            "memory": {"peak_host_rss_gb": 4.0, "peak_device_gb": 3.5}}
+    diff = telemetry.diff_rollups(_MEM_ROLLUPS, cand)
+    assert diff["regressed"] is True
+    assert diff["regressions"] == ["memory:peak_device_gb"]
+    assert diff["memory"]["peak_device_gb"]["delta_gb"] \
+        == pytest.approx(1.5)
+    # self-compare passes
+    ok = telemetry.diff_rollups(_MEM_ROLLUPS, _MEM_ROLLUPS)
+    assert ok["regressed"] is False
+
+
+def test_diff_rollups_memory_abs_floor_and_threshold():
+    """Small absolute growth under the GiB floor never regresses; the
+    floor is configurable like the seconds floor."""
+    cand = {**_MEM_ROLLUPS,
+            "memory": {"peak_host_rss_gb": 4.2, "peak_device_gb": 2.0}}
+    assert telemetry.diff_rollups(
+        _MEM_ROLLUPS, cand)["regressed"] is False      # +0.2 < 1.0 rel floor
+    tight = telemetry.diff_rollups(_MEM_ROLLUPS, cand,
+                                   mem_abs_floor_gb=0.05,
+                                   rel_threshold=0.01)
+    assert "memory:peak_host_rss_gb" in tight["regressions"]
+
+
+def test_diff_rollups_baseline_without_memory_skips():
+    """Pre-memory baselines (e.g. the committed TRACE_r07) degrade to
+    skipping the memory checks — never a crash or false regression."""
+    diff = telemetry.diff_rollups(_BASE_ROLLUPS, _MEM_ROLLUPS)
+    assert diff["regressed"] is False
+    assert diff["memory"]["peak_host_rss_gb"]["skipped"] is True
+    rev = telemetry.diff_rollups(_MEM_ROLLUPS, _BASE_ROLLUPS)
+    assert rev["regressed"] is False
+
+
+def test_diff_rollups_malformed_artifacts_never_crash():
+    """Satellite 3: missing rollup keys, empty span lists, wrong-typed
+    sections and junk values all degrade to skip/zero, keeping the
+    trace-diff gate alive."""
+    cases = [
+        {}, {"stage_seconds": None}, {"stage_seconds": "junk"},
+        {"memory": "junk"}, {"memory": {"peak_host_rss_gb": "junk"}},
+        {"stage_seconds": {"sync-execute": "junk"},
+         "device_busy_s": None, "pipeline_bubble_frac": "junk",
+         "memory": {"peak_host_rss_gb": None}},
+        telemetry.rollup_spans([]),      # empty trace, real shape
+    ]
+    for a in cases:
+        for b in cases:
+            diff = telemetry.diff_rollups(a, b)
+            assert diff["regressed"] is False, (a, b, diff)
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace shards + merge (ISSUE 17 tentpole c)
+# ---------------------------------------------------------------------------
+
+def test_trace_shard_roundtrip(fake_clock, tmp_path):
+    with telemetry.span("block:0", cat="block", block=0) as sp:
+        telemetry.annotate_memory(sp)
+    path = str(tmp_path / "trace_shard_p0.json")
+    n = telemetry.export_trace_shard(path, process_index=0,
+                                     process_count=2,
+                                     wall_anchor=100.0, perf_anchor=1.0)
+    sh = telemetry.load_trace_shard(path)
+    assert sh["process_index"] == 0 and sh["process_count"] == 2
+    assert sh["wall_anchor"] == 100.0 and sh["perf_anchor"] == 1.0
+    assert len(sh["spans"]) == n >= 2          # block span + counter
+
+
+def _synthetic_shard(path, pidx, wall_anchor, perf_anchor, spans):
+    doc = {"process_index": pidx, "process_count": 2,
+           "wall_anchor": wall_anchor, "perf_anchor": perf_anchor,
+           "dropped": 0,
+           "spans": [{"sid": i + 1, "parent": None, "name": n,
+                      "cat": c, "t0": t0, "t1": t1, "tid": 1,
+                      "tname": "MainThread", "attrs": a}
+                     for i, (n, c, t0, t1, a) in enumerate(spans)]}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def test_merge_chrome_traces_rebases_and_remaps(tmp_path):
+    """Two shards with different clock origins merge into ONE trace:
+    pids remapped per process, timestamps rebased through the
+    barrier-aligned anchors, and the merged rollups aggregate
+    device_busy_s across the mesh (cross-checked per process)."""
+    p0 = str(tmp_path / "trace_shard_p0.json")
+    p1 = str(tmp_path / "trace_shard_p1.json")
+    # process 0: perf clock starts at 1000; process 1: at 5; their wall
+    # anchors differ by 0.5 s (process 1 reached the barrier later)
+    _synthetic_shard(p0, 0, 100.0, 1000.0, [
+        ("sync-execute", "stage", 1000.0, 1000.5,
+         {"mem_dev_peak_gb": 1.0}),
+        ("host-map", "stage", 1000.5, 1000.6, {})])
+    _synthetic_shard(p1, 1, 100.5, 5.0, [
+        ("sync-execute", "stage", 5.0, 5.25, {"mem_dev_peak_gb": 2.0})])
+    out = str(tmp_path / "merged.json")
+    m = telemetry.merge_chrome_traces([p1, p0], out)   # order-insensitive
+    assert m["n_processes"] == 2
+    assert [p["pid"] for p in m["processes"]] == [1, 2]
+    assert [p["clock_offset_s"] for p in m["processes"]] == [0.0, 0.5]
+    busy = {p["process_index"]: p["device_busy_s"]
+            for p in m["processes"]}
+    assert busy == {0: 0.5, 1: 0.25}
+    assert m["rollups"]["device_busy_s"] == pytest.approx(0.75)
+    assert m["rollups"]["memory"]["peak_device_gb"] == pytest.approx(2.0)
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    assert {e["pid"] for e in events} == {1, 2}
+    xs = {e["name"]: e for e in events if e["ph"] == "X"}
+    # p1's span started 0.5 s into p0's timeline after the wall rebase:
+    # (5.0 - 5.0) + (100.5 - 100.0) -> +0.5 s from the trace base
+    assert xs["sync-execute"]["ts"] in (0, 500_000)
+    assert all(e["ts"] >= 0 for e in events if "ts" in e)
+    # merged trace is a loadable Chrome trace: every event well-formed
+    assert all({"ph", "pid", "name"} <= set(e) for e in events)
+
+
+def test_merge_chrome_traces_empty_raises(tmp_path):
+    with pytest.raises(ValueError):
+        telemetry.merge_chrome_traces([], str(tmp_path / "out.json"))
+
+
+# ---------------------------------------------------------------------------
+# crash flight recorder (ISSUE 17 tentpole d)
+# ---------------------------------------------------------------------------
+
+def test_flight_record_dump_contents(fake_clock, tmp_path):
+    """The dump carries the span ring, a live memory probe + rollup, the
+    process identity and caller-supplied correlation state — written
+    atomically (no .tmp litter)."""
+    with telemetry.correlation("req_42"):
+        with telemetry.span("block:0", cat="block", block=0) as sp:
+            telemetry.annotate_memory(sp)
+    path = telemetry.flight_record(
+        str(tmp_path), "tenant-fault:req_42",
+        extra={"request": "req_42", "tenant": "alice"})
+    assert os.path.basename(path).startswith("flightrec_tenant-fault")
+    assert not [p for p in os.listdir(str(tmp_path)) if ".tmp" in p]
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "tenant-fault:req_42"
+    assert doc["extra"] == {"request": "req_42", "tenant": "alice"}
+    assert doc["n_spans"] == len(doc["spans"]) >= 2
+    assert any(s["attrs"].get("corr") == "req_42" for s in doc["spans"])
+    assert doc["memory"]["probe"]["host"]["rss"] > 0
+    assert doc["memory"]["rollup"]["peak_host_rss_gb"] > 0
+    assert doc["process_count"] >= 1
+    assert telemetry.flight_record_count() == 1
+    # the counter surfaces in the Prometheus families
+    fams = {f[0]: f for f in telemetry.metrics_families()}
+    assert fams["ctt_telemetry_flight_records_total"][3] == [(None, 1)]
+
+
+def test_flight_record_works_with_telemetry_disabled(tmp_path):
+    assert not telemetry.enabled()
+    path = telemetry.flight_record(str(tmp_path), "sigterm")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["n_spans"] == 0 and doc["spans"] == []
+    assert doc["memory"]["probe"]["host"]["hwm"] > 0
+
+
+def test_install_flight_recorder_chains_and_uninstalls(tmp_path):
+    """The excepthook wrapper dumps a record, then CHAINS the previous
+    hook; uninstall restores it exactly."""
+    import sys as _sys
+
+    seen = []
+    prev = _sys.excepthook
+    _sys.excepthook = lambda *a: seen.append(a)
+    try:
+        uninstall = telemetry.install_flight_recorder(
+            str(tmp_path), extra_fn=lambda: {"stage": "serve"})
+        try:
+            err = ValueError("boom")
+            _sys.excepthook(ValueError, err, None)
+        finally:
+            uninstall()
+        assert _sys.excepthook is not prev
+        assert len(seen) == 1 and seen[0][1] is err
+        recs = [p for p in os.listdir(str(tmp_path))
+                if p.startswith("flightrec_")]
+        assert len(recs) == 1
+        with open(os.path.join(str(tmp_path), recs[0])) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "exception"
+        assert doc["extra"]["exc_type"] == "ValueError"
+        assert doc["extra"]["stage"] == "serve"
+    finally:
+        _sys.excepthook = prev
