@@ -62,6 +62,10 @@ import time
 
 import numpy as np
 
+# atomic artifact writes (tmp + os.replace): a watcher tailing BENCH_*
+# JSON must never observe a truncated document (ctt-lint: atomic-write)
+from cluster_tools_tpu.core.config import write_config
+
 def _env_shape(name, default):
     val = os.environ.get(name)
     return tuple(int(x) for x in val.split(",")) if val else default
@@ -488,8 +492,7 @@ def main_mesh():
     out["peak_rss_gb"] = round(telemetry.host_peak_rss_gb(), 2)
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_mesh.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+    write_config(path, out)
     print(json.dumps({"metric": out["metric"],
                       "shape": out["shape"],
                       "per_block_wall_s": block_entry["wall_s"],
@@ -722,8 +725,7 @@ def main_warm():
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_warm.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+    write_config(path, out)
     print(json.dumps({
         "metric": out["metric"],
         "cold_wall_s": cold["wall_s"], "warm_wall_s": warm["wall_s"],
@@ -883,8 +885,7 @@ def main():
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_r06_full.json")
-    with open(detail_path, "w") as f:
-        json.dump(full, f, indent=1)
+    write_config(detail_path, full)
     print(f"full per-trial report: {detail_path}", file=sys.stderr,
           flush=True)
 
@@ -1038,8 +1039,7 @@ def main_trace():
         },
     }
     path = os.path.join(here, "TRACE_r07.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+    write_config(path, out)
     print(json.dumps({
         "metric": out["metric"],
         "wall_off_s": out["wall_off_s"],
@@ -1172,8 +1172,7 @@ def main_serve():
         here = os.path.dirname(os.path.abspath(__file__))
         out_path = os.path.join(here, "BENCH_serve.json")
     if out_path:
-        with open(out_path, "w") as f:
-            json.dump(out, f, indent=1)
+        write_config(out_path, out)
     print(json.dumps({
         "metric": out["metric"], "mode": out["mode"],
         "levels": [{"offered_hz": r["offered_hz"],
@@ -1235,11 +1234,27 @@ def main_trace_diff(argv):
     sys.exit(1 if diff["regressed"] else 0)
 
 
+def main_lint(argv):
+    """Run the full ctt-lint analyzer and commit the report as a bench
+    artifact (LINT_r18.json) — same schema family as BENCH_*/TRACE_*
+    (identity via ``cmd: "lint"``), so artifact hygiene tests cover it."""
+    from cluster_tools_tpu import analysis
+
+    out = "LINT_r18.json"
+    args = list(argv)
+    if "--json" in args:
+        out = args[args.index("--json") + 1]
+        del args[args.index("--json"):args.index("--json") + 2]
+    sys.exit(analysis.main(args + ["--json", out]))
+
+
 if __name__ == "__main__":
     if os.environ.get("BENCH_MESH") or "mesh" in sys.argv[1:]:
         main_mesh()
     elif os.environ.get("BENCH_WARM") or "warm" in sys.argv[1:]:
         main_warm()
+    elif "lint" in sys.argv[1:]:
+        main_lint([a for a in sys.argv[1:] if a != "lint"])
     elif "trace-diff" in sys.argv[1:]:
         main_trace_diff(
             [a for a in sys.argv[1:] if a != "trace-diff"])

@@ -38,6 +38,7 @@ OFFSETS = [[-1, 0, 0], [0, -1, 0], [0, 0, -1],
 # env-overridable geometry for smoke runs on small hosts; every config
 # records the shape it actually measured in its JSON
 from bench import _env_shape  # noqa: E402  (same directory)
+from cluster_tools_tpu.core.config import write_config  # noqa: E402
 
 
 def _blob_volume(shape, seed=0):
@@ -440,8 +441,7 @@ def main():
         res = fn()
         res["bench_seconds"] = round(time.perf_counter() - t0, 1)
         out = os.path.join(ROOT, f"BENCH_config{name}.json")
-        with open(out, "w") as f:
-            json.dump(res, f, indent=1)
+        write_config(out, res)
         print(json.dumps(res), flush=True)
 
 

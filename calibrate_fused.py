@@ -23,6 +23,8 @@ import time
 
 import numpy as np
 
+from cluster_tools_tpu.core.config import write_config
+
 BLOCK = [50, 512, 512]
 HALO = [4, 32, 32]
 CFG = dict(threshold=0.25, sigma_seeds=2.0, sigma_weights=2.0, alpha=0.8,
@@ -202,11 +204,11 @@ def main():
     print(f"  {'TOTAL':<14s} {total:7.3f}s")
 
     if args.json:
-        with open(args.json, "w") as f:
-            json.dump({"outer_shape": list(outer_shape),
-                       "cumulative": dict(cum), "per_stage": table,
-                       "compile_s": compile_s,
-                       "total_s": cum[-1][1]}, f, indent=1)
+        write_config(args.json,
+                     {"outer_shape": list(outer_shape),
+                      "cumulative": dict(cum), "per_stage": table,
+                      "compile_s": compile_s,
+                      "total_s": cum[-1][1]})
 
 
 if __name__ == "__main__":

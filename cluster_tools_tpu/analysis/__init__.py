@@ -1,0 +1,21 @@
+"""ctt-lint: static-analysis passes over the whole package.
+
+One CLI (``python -m cluster_tools_tpu.analysis``), ~7 AST passes, one
+pragma.  See :mod:`.base` for the framework, the sibling modules for
+the individual rules, and ``core.runtime`` for the dynamic half (the
+lock-order witness).
+"""
+
+from .base import (ALL_RULES, Finding, Pass, SourceFile, load_passes,
+                   report_as_json, run_analysis)
+from . import sources
+
+__all__ = [
+    "ALL_RULES", "Finding", "Pass", "SourceFile", "load_passes",
+    "report_as_json", "run_analysis", "sources", "main",
+]
+
+
+def main(argv=None) -> int:
+    from .__main__ import main as _main
+    return _main(argv)

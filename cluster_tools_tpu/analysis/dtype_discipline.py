@@ -1,0 +1,110 @@
+"""dtype-f64 / dtype-int32: dtype discipline on ``ops/`` paths.
+
+* ``dtype-f64`` — float64 introduced inside a DIRECTLY traced scope
+  (jit/pjit/shard_map decorated or passed to one).  JAX defaults to
+  f32 and the x64 flag is off; an f64 literal/astype/dtype= in a
+  traced program either silently downcasts or doubles device memory
+  if x64 is ever enabled.  Host-side f64 staging helpers (e.g. the
+  gaussian-kernel constant builder) are fine and out of scope.
+* ``dtype-int32`` — ``.astype(int32)`` on names that look like packed
+  keys / seed ids / label offsets, anywhere in ``ops/``.  Global seed
+  ids exceed 2**31 on real volumes (the PR-10 corruption class); the
+  sanctioned route is ``ops.mws.compact_seeds_int32`` which
+  range-checks after compaction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .base import Finding, Pass, SourceFile, dotted_name
+from .trace_purity import traced_functions
+
+_F64 = frozenset({"float64", "f8", "double"})
+_I32 = frozenset({"int32", "i4"})
+#: receiver-name tokens that mark a global-id/packed-key value.
+#: Deliberately does NOT include "label": post-relabel dense labels are
+#: block-local by construction; the >2**31 corruption class is global
+#: SEED/packed-edge ids.
+_KEY_TOKENS = ("seed", "packed", "key", "offset")
+
+
+def _dtype_token(node: ast.AST) -> Optional[str]:
+    """'float64' / 'int32' / ... for a dtype-valued expression."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    name = dotted_name(node)
+    if name:
+        return name.rsplit(".", 1)[-1]
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id.lower())
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr.lower())
+    return out
+
+
+def _is_keyish(node: ast.AST) -> bool:
+    names = _names_in(node)
+    return any(tok in n for n in names for tok in _KEY_TOKENS)
+
+
+def run(sf: SourceFile) -> List[Finding]:
+    if not sf.in_dir("ops"):
+        return []
+    traced_functions(sf)               # populates traced_fns_direct
+    direct = sf.cache.get("traced_fns_direct", set())
+    in_traced: Set[int] = set()
+    for fn in direct:
+        for node in ast.walk(fn):
+            if hasattr(node, "lineno"):
+                in_traced.add(id(node))
+
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # .astype(<dtype>)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype" and node.args:
+            tok = _dtype_token(node.args[0])
+            if tok in _F64 and id(node) in in_traced:
+                out.append(Finding(
+                    sf.rel, node.lineno, "dtype-f64",
+                    "astype(%s) inside a traced program — JAX x64 is "
+                    "off; keep device math in f32" % tok))
+            elif tok in _I32 and _is_keyish(node.func.value):
+                out.append(Finding(
+                    sf.rel, node.lineno, "dtype-int32",
+                    "bare int32 cast on a packed-key/seed-id value — "
+                    "global ids exceed 2**31; use "
+                    "ops.mws.compact_seeds_int32"))
+            continue
+        if id(node) not in in_traced:
+            continue
+        # np.float64(x) / jnp.float64(x) constructor
+        fn_name = dotted_name(node.func)
+        if fn_name and fn_name.rsplit(".", 1)[-1] in _F64:
+            out.append(Finding(
+                sf.rel, node.lineno, "dtype-f64",
+                "%s(...) inside a traced program — JAX x64 is off; "
+                "keep device math in f32" % fn_name))
+            continue
+        # dtype="float64" keyword in a traced scope
+        for kw in node.keywords:
+            if kw.arg == "dtype" and _dtype_token(kw.value) in _F64:
+                out.append(Finding(
+                    sf.rel, kw.value.lineno, "dtype-f64",
+                    "dtype=float64 inside a traced program — JAX x64 "
+                    "is off; keep device math in f32"))
+    return out
+
+
+PASS = Pass(name="dtype-discipline",
+            rules=("dtype-f64", "dtype-int32"), run=run)

@@ -1,0 +1,174 @@
+"""trace-purity: host side effects inside traced (jit/pjit/shard_map)
+functions.
+
+A host effect at trace time does not error — it silently runs ONCE and
+is baked into the compiled program as a constant: ``time.time()``
+becomes a frozen timestamp, ``random.random()`` a fixed number,
+``print`` fires only on the first trace, ``np.asarray`` forces a
+device sync mid-program.  All of these corrupt either the measurement
+("1 compile, 1 wait per volume" dispatch accounting) or the program
+itself.
+
+Traced scope discovery:
+
+* functions decorated with ``jit``/``pjit``/``shard_map`` (bare,
+  dotted, called form, or via ``partial(jax.jit, ...)``),
+* functions referenced by name inside a ``jax.jit(...)`` /
+  ``shard_map(...)`` call expression (covers ``jax.jit(run)``,
+  ``jax.jit(jax.vmap(run, ...))``, ``shard_map(body, mesh=...)``),
+* same-module transitive closure: helpers called by a traced function
+  are traced too (simple-name call graph).
+
+``jax.*`` / ``jnp.*`` / ``lax.*`` calls are never flagged: JAX's own
+functional effects (``jax.random``, ``jax.debug.print``,
+``jax.pure_callback``) are the sanctioned in-trace forms.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Finding, Pass, SourceFile, dotted_name
+
+TRACE_ENTRY = frozenset({"jit", "pjit", "shard_map"})
+_JAX_ROOTS = frozenset({"jax", "jnp", "lax"})
+_NP_ROOTS = frozenset({"np", "numpy"})
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_trace_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn and _last(fn) in TRACE_ENTRY:
+            return True
+        if fn and _last(fn) == "partial":
+            return any(
+                (an := dotted_name(a)) and _last(an) in TRACE_ENTRY
+                for a in dec.args)
+        return False
+    fn = dotted_name(dec)
+    return bool(fn) and _last(fn) in TRACE_ENTRY
+
+
+def _violation(call: ast.Call) -> Optional[str]:
+    """A human-readable reason when ``call`` is a host effect, else
+    None."""
+    fn = dotted_name(call.func)
+    if fn is None:
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "block_until_ready":
+            return ".block_until_ready() forces a device sync at " \
+                   "trace time"
+        return None
+    root = fn.split(".", 1)[0]
+    if root in _JAX_ROOTS:
+        return None
+    last = _last(fn)
+    if last == "block_until_ready":
+        return ".block_until_ready() forces a device sync at trace time"
+    if fn == "print":
+        return "print() at trace time fires once and vanishes from " \
+               "the compiled program (use jax.debug.print)"
+    if fn == "open":
+        return "file IO at trace time runs once and is not part of " \
+               "the compiled program"
+    if fn.startswith("time."):
+        return "%s() at trace time bakes a frozen host timestamp " \
+               "into the program" % fn
+    if fn.startswith("os.") and not fn.startswith("os.path."):
+        return "%s() is host OS access at trace time" % fn
+    if fn.startswith("random."):
+        return "%s() bakes a fixed host-RNG draw into the program " \
+               "(use jax.random)" % fn
+    if root in _NP_ROOTS:
+        sub = fn.split(".")
+        if len(sub) >= 3 and sub[1] == "random":
+            return "%s() bakes a fixed host-RNG draw into the " \
+                   "program (use jax.random)" % fn
+        if last in ("asarray", "array"):
+            return "%s() on a traced value forces host " \
+                   "materialization mid-trace" % fn
+    return None
+
+
+def traced_functions(sf: SourceFile) -> Set[ast.AST]:
+    """All FunctionDef nodes that (transitively) execute under trace.
+    Memoized on ``sf.cache`` for reuse by the dtype pass."""
+    if "traced_fns" in sf.cache:
+        return sf.cache["traced_fns"]
+
+    by_name: Dict[str, List[ast.AST]] = {}
+    all_fns: List[ast.AST] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+            all_fns.append(node)
+
+    roots: Set[ast.AST] = set()
+    direct: Set[ast.AST] = set()
+    for fn in all_fns:
+        if any(_is_trace_decorator(d) for d in fn.decorator_list):
+            roots.add(fn)
+            direct.add(fn)
+
+    # names referenced inside jit(...)/shard_map(...) call expressions
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = dotted_name(node.func)
+        if not cn or _last(cn) not in TRACE_ENTRY:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name) and sub.id in by_name:
+                    for fn in by_name[sub.id]:
+                        roots.add(fn)
+                        direct.add(fn)
+
+    # transitive closure over same-module simple-name calls
+    traced = set(roots)
+    queue = list(roots)
+    while queue:
+        fn = queue.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in by_name:
+                for callee in by_name[node.func.id]:
+                    if callee not in traced:
+                        traced.add(callee)
+                        queue.append(callee)
+
+    sf.cache["traced_fns"] = traced
+    sf.cache["traced_fns_direct"] = direct
+    return traced
+
+
+def run(sf: SourceFile) -> List[Finding]:
+    traced = traced_functions(sf)
+    if not traced:
+        return []
+    seen: Set[Tuple[int, str]] = set()
+    out: List[Finding] = []
+    for fn in traced:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            why = _violation(node)
+            if why is None:
+                continue
+            key = (node.lineno, why)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                sf.rel, node.lineno, "trace-purity",
+                "in traced function `%s`: %s" % (fn.name, why)))
+    return out
+
+
+PASS = Pass(name="trace-purity", rules=("trace-purity",), run=run)

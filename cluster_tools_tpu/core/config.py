@@ -51,7 +51,28 @@ def default_global_config() -> Dict[str, Any]:
         # "latency_s", "target"} objective dicts for the resident
         # server's SLO engine; None = slo.default_objectives()
         "slo_objectives": None,
+        # multihost barrier wait bound in seconds (core.multihost);
+        # None = wait forever (single-host default)
+        "barrier_timeout": None,
     }
+
+
+#: global-config keys that are read via ``.get()`` but deliberately NOT
+#: part of :func:`default_global_config` (written by tasks at runtime,
+#: not user-tunable).  The ``config-key`` lint pass accepts these too.
+EXTRA_GLOBAL_CONFIG_KEYS = frozenset({
+    # recorded by FusedProblemWorkflow so downstream solver tasks
+    # iterate the same slab grid (PR 12)
+    "sub_graph_block_shape",
+})
+
+
+def declared_global_config_keys() -> frozenset:
+    """Every key a ``global_config.get("...")`` access may legally use —
+    the schema the ``config-key`` static-analysis pass checks against."""
+    return frozenset(default_global_config()) \
+        | frozenset(default_task_resources()) \
+        | EXTRA_GLOBAL_CONFIG_KEYS
 
 
 def default_task_resources() -> Dict[str, Any]:

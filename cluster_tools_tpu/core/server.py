@@ -411,7 +411,10 @@ class ResidentSegmentationServer:
         self._metrics_last = 0.0
         self._queues: "OrderedDict[str, deque]" = OrderedDict()
         self._rr_next = 0                 # round-robin cursor over tenants
-        self._lock = threading.Lock()
+        # named_lock: plain threading.Lock normally; under the lock-order
+        # witness (runtime.lock_witness_configure) an instrumented lock
+        # recording acquisition order + blocking-under-lock violations
+        self._lock = runtime.named_lock(f"server:{name}")
         self._work = threading.Condition(self._lock)
         # accepting from construction: requests may queue BEFORE start()
         # (the worker only begins consuming once started)
@@ -449,6 +452,7 @@ class ResidentSegmentationServer:
         """Stop accepting requests; with ``drain=True`` (default) every
         queued request still completes before the worker exits, with
         ``drain=False`` queued-but-unstarted requests are cancelled."""
+        cancelled = []
         with self._lock:
             self._accepting = False
             if not drain:
@@ -462,17 +466,22 @@ class ResidentSegmentationServer:
                         if req.state == "queued":
                             req.state = "cancelled"
                             req.error = "cancelled at shutdown"
-                            try:
-                                self._write_status(req)
-                            except OSError:
-                                pass
-                            req.done.set()
+                            cancelled.append(req)
                         else:
                             keep.append(req)
                     q.clear()
                     q.extend(keep)
                 self._occupancy_sample_locked("cancel")
             self._work.notify_all()
+        # status IO + done-event wakeups happen OUTSIDE the lock
+        # (ctt-lint blocking-under-lock): the state flip and dequeue
+        # above were atomic, so the worker can no longer claim these
+        for req in cancelled:
+            try:
+                self._write_status(req)
+            except OSError:
+                pass
+            req.done.set()
         if self._thread is not None:
             self._thread.join(timeout)
             if not self._thread.is_alive():
@@ -523,10 +532,24 @@ class ResidentSegmentationServer:
             if not self._accepting:
                 raise RuntimeError(f"{self.name} is not accepting "
                                    "requests (shut down?)")
+            depth, in_flight = self._gauges_locked()
+        # pre-publish the queued status OUTSIDE the lock (ctt-lint
+        # blocking-under-lock): the file exists before the worker can
+        # see the request, so every later write (claim-time gauge
+        # re-snapshot, terminal states) strictly supersedes this one.
+        # Gauges count this request manually — it is not enqueued yet.
+        req.queue_depth = depth + 1
+        req.in_flight = dict(in_flight)
+        self._write_status(req)
+        with self._lock:
+            if not self._accepting:
+                # raced with shutdown between the two critical sections
+                req.state = "cancelled"
+                req.error = "cancelled at shutdown"
+                raise RuntimeError(f"{self.name} is not accepting "
+                                   "requests (shut down?)")
             self._queues.setdefault(tenant, deque()).append(req)
-            req.queue_depth, req.in_flight = self._gauges_locked()
             self._occupancy_sample_locked("enqueue")
-            self._write_status(req)
             self._work.notify_all()
         return RequestHandle(req)
 
@@ -651,7 +674,10 @@ class ResidentSegmentationServer:
             families += self.slo.metrics_families(rep)
         families += runtime.metrics_families()
         families += telemetry.metrics_families()
-        return telemetry.write_prometheus(path, families)
+        # witness marker: the Prometheus rewrite must never run under
+        # the server lock (write_metrics itself takes it above)
+        with runtime.witness_blocking("metrics-write"):
+            return telemetry.write_prometheus(path, families)
 
     # -- scheduler -----------------------------------------------------
     def _pick(self) -> Optional[_Request]:
@@ -910,4 +936,6 @@ class ResidentSegmentationServer:
         if req.result is not None:
             status["n_fragments"] = req.result.get("n_fragments")
             status["n_segments"] = req.result.get("n_segments")
-        config_mod.write_config(req.status_path, status)
+        # witness marker: status IO must never run under the server lock
+        with runtime.witness_blocking("status-write"):
+            config_mod.write_config(req.status_path, status)

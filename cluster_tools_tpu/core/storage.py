@@ -22,6 +22,8 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .config import write_config
+
 try:
     import tensorstore as ts
 except ImportError:  # pragma: no cover - tensorstore is expected in the image
@@ -94,7 +96,7 @@ class AttrsView:
     def __setitem__(self, key: str, value: Any) -> None:
         if self._guard and key in self._N5_RESERVED:
             raise KeyError(f"{key} is reserved N5 metadata")
-        with self._lock:
+        with self._lock:  # ctt-lint: disable=blocking-under-lock (the attrs-file load-modify-store IS the critical section; the lock exists to serialize exactly this IO)
             data = self._load()
             data[key] = value
             self._store(data)
@@ -106,7 +108,7 @@ class AttrsView:
         return self._load().get(key, default)
 
     def update(self, other: Dict[str, Any]) -> None:
-        with self._lock:
+        with self._lock:  # ctt-lint: disable=blocking-under-lock (the attrs-file load-modify-store IS the critical section; the lock exists to serialize exactly this IO)
             data = self._load()
             data.update(other)
             self._store(data)
@@ -318,8 +320,7 @@ class ZarrFile(_TSContainer):
         if not os.path.exists(zgroup) and not os.path.exists(
             os.path.join(self.path, ".zarray")
         ):
-            with open(zgroup, "w") as f:
-                json.dump({"zarr_format": 2}, f)
+            write_config(zgroup, {"zarr_format": 2})
 
     def _is_dataset(self, key: str) -> bool:
         return os.path.exists(os.path.join(self.path, key, ".zarray"))
@@ -357,8 +358,7 @@ class N5File(_TSContainer):
     def _init_root(self) -> None:
         attrs = os.path.join(self.path, "attributes.json")
         if not os.path.exists(attrs):
-            with open(attrs, "w") as f:
-                json.dump({"n5": "2.0.0"}, f)
+            write_config(attrs, {"n5": "2.0.0"})
 
     def _is_dataset(self, key: str) -> bool:
         meta = os.path.join(self.path, key, "attributes.json")

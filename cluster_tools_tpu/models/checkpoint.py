@@ -20,6 +20,8 @@ import json
 import os
 from typing import Any, Dict, Tuple, Optional
 
+from ..core.config import write_config
+
 import numpy as np
 
 
@@ -51,8 +53,7 @@ def save_checkpoint(path: str, model_config: Dict[str, Any], params: Any) -> Non
     (``out_channels``, ``features``, ``anisotropic``).
     """
     os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, "model.json"), "w") as f:
-        json.dump(model_config, f)
+    write_config(os.path.join(path, "model.json"), model_config)
     flat = _flatten(params)
     np.savez(os.path.join(path, "params.npz"), **flat)
 

@@ -94,6 +94,7 @@ def seeded_watershed(
     ``CTT_WS_METHOD`` overrides the default."""
     import os
 
+    # ctt-lint: disable=trace-purity (dead under trace: _batched_impl always passes method explicitly, so the env read only runs on direct host calls)
     method = method or os.environ.get("CTT_WS_METHOD", "basins")
     if method == "basins":
         return seeded_watershed_basins(height, seeds, mask, connectivity)
@@ -159,6 +160,7 @@ def seeded_watershed_flood(
         lambda s: s[1] & (s[2] < max_iter), jump_body,
         (parent, jnp.bool_(True), jnp.int32(0)))
 
+    # ctt-lint: disable=dtype-int32 (caller contract: seeds are block-local compacted ids — sweep.sweep_watershed / mws.compact_seeds_int32 rank-compact before calling)
     seed_flat = seeds.astype(jnp.int32).reshape(-1)
     labels = seed_flat[parent]
     labels = jnp.where(mask.reshape(-1), labels, 0)
@@ -321,6 +323,7 @@ def _basins_impl(height, seeds, mask, connectivity: int, max_rounds: int,
     # A seeded voxel may only point within its own seed cluster — without
     # this, ADJACENT clusters with different ids (dense seeds, e.g. the
     # size-filter regrow) would chain into one root and merge labels.
+    # ctt-lint: disable=dtype-int32 (caller contract: seeds are block-local compacted ids, see seeded_watershed_flood)
     sv = seeds.astype(jnp.int32)
     best_h, best_i = h, flat_idx
     for off in offsets:
@@ -346,6 +349,7 @@ def _basins_impl(height, seeds, mask, connectivity: int, max_rounds: int,
 
     root = jump(parent)
 
+    # ctt-lint: disable=dtype-int32 (caller contract: seeds are block-local compacted ids, see seeded_watershed_flood)
     seed_flat = seeds.astype(jnp.int32).reshape(-1)
     mask_flat = mask.reshape(-1)
     h_flat = jnp.where(mask, height, big).reshape(-1)

@@ -15,6 +15,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ..core.blocking import Blocking
+from ..core.config import write_config
 from ..core.runtime import BlockTask
 from ..core.storage import file_reader
 
@@ -49,8 +50,7 @@ class CheckSubGraphs(BlockTask):
                 with open(os.path.join(self.tmp_folder, name)) as f:
                     failed.extend(json.load(f))
         out = os.path.join(self.tmp_folder, "check_sub_graphs_failed.json")
-        with open(out, "w") as f:
-            json.dump(sorted(failed), f)
+        write_config(out, sorted(failed))
         if failed:
             raise RuntimeError(
                 f"{len(failed)} blocks have inconsistent sub-graphs: "
@@ -81,10 +81,9 @@ class CheckSubGraphs(BlockTask):
                     np.sort(nodes), nodes_seg):
                 failed.append(int(block_id))
             log_fn(f"processed block {block_id}")
-        with open(os.path.join(
-                job_config["tmp_folder"],
-                f"check_sub_graphs_failed_job{job_id}.json"), "w") as fo:
-            json.dump(failed, fo)
+        write_config(os.path.join(
+            job_config["tmp_folder"],
+            f"check_sub_graphs_failed_job{job_id}.json"), failed)
 
 
 class CheckComponents(BlockTask):
@@ -137,8 +136,7 @@ class CheckComponents(BlockTask):
             _, n_comp = ndimage.label(obj, structure=struct)
             if n_comp != 1:
                 disconnected.append(int(label_id))
-        with open(cfg["output_path"], "w") as fo:
-            json.dump(disconnected, fo)
+        write_config(cfg["output_path"], disconnected)
         log_fn(f"{len(disconnected)} disconnected segments of "
                f"{cfg['n_labels']}")
 

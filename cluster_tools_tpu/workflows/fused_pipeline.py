@@ -75,6 +75,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..core.blocking import Blocking
+from ..core.config import write_config
 from ..core.runtime import BlockTask
 from ..core.storage import file_reader
 from ..core import graph as g
@@ -843,9 +844,8 @@ class FusedSegmentationBlocks(BlockTask):
                  ds_in, ds_out, tmp_folder, state, max_ids)
             with file_reader(cfg["output_path"]) as f:
                 f[cfg["output_key"]].attrs["maxId"] = int(state["offset"])
-            with open(os.path.join(tmp_folder, "fused_max_ids.json"),
-                      "w") as fo:
-                json.dump({str(k_): v for k_, v in max_ids.items()}, fo)
+            write_config(os.path.join(tmp_folder, "fused_max_ids.json"),
+                         {str(k_): v for k_, v in max_ids.items()})
             return
 
         def submit(entry):
@@ -897,8 +897,8 @@ class FusedSegmentationBlocks(BlockTask):
 
         with file_reader(cfg["output_path"]) as f:
             f[cfg["output_key"]].attrs["maxId"] = int(state["offset"])
-        with open(os.path.join(tmp_folder, "fused_max_ids.json"), "w") as fo:
-            json.dump({str(k_): v for k_, v in max_ids.items()}, fo)
+        write_config(os.path.join(tmp_folder, "fused_max_ids.json"),
+                     {str(k_): v for k_, v in max_ids.items()})
 
 
     @classmethod
@@ -943,10 +943,9 @@ class FusedSegmentationBlocks(BlockTask):
         # the interior samples (a thin plane's own max is not the volume's)
         scale = 255.0 if (mx > 1.0 and mx <= 255) else (mx if mx > 1.0
                                                         else 1.0)
-        with open(os.path.join(tmp_folder, "fused_input_scale.json"),
-                  "w") as fo:
-            json.dump({"scale": scale,
-                       "invert": bool(cfg.get("invert_inputs", False))}, fo)
+        write_config(os.path.join(tmp_folder, "fused_input_scale.json"),
+                     {"scale": scale,
+                      "invert": bool(cfg.get("invert_inputs", False))})
         if not is_u8:
             vol = _normalize_input(vol.astype("float32"), cfg)
         _raw_cache_put((os.path.abspath(cfg["input_path"]),
@@ -1230,11 +1229,9 @@ class FusedSegmentationBlocks(BlockTask):
                  and not cfg.get("invert_inputs", False))
         scale = 255.0 if (mx > 1.0 and mx <= 255) else (mx if mx > 1.0
                                                         else 1.0)
-        with open(os.path.join(tmp_folder, "fused_input_scale.json"),
-                  "w") as fo:
-            json.dump({"scale": scale,
-                       "invert": bool(cfg.get("invert_inputs", False))},
-                      fo)
+        write_config(os.path.join(tmp_folder, "fused_input_scale.json"),
+                     {"scale": scale,
+                      "invert": bool(cfg.get("invert_inputs", False))})
         if not is_u8:
             vol = _normalize_input(vol.astype("float32"), cfg)
         _raw_cache_put((os.path.abspath(cfg["input_path"]),

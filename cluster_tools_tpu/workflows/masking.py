@@ -14,6 +14,7 @@ from typing import Any, Dict, Optional, Sequence
 import numpy as np
 
 from ..core.blocking import Blocking
+from ..core.config import write_config
 from ..core.runtime import BlockTask
 from ..core.storage import file_reader
 from ..core.workflow import FileTarget, Task
@@ -46,8 +47,7 @@ class BlocksFromMask(Task):
         blocks = [bid for bid in range(blocking.n_blocks)
                   if np.any(np.asarray(
                       mask[blocking.get_block(bid).bb]) > 0)]
-        with open(self.output_path, "w") as f:
-            json.dump(blocks, f)
+        write_config(self.output_path, blocks)
         self.output().touch()
 
     def output(self):

@@ -35,6 +35,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ..core.blocking import Blocking
+from ..core.config import write_config
 from ..core.runtime import BlockTask
 from ..core.storage import file_reader
 
@@ -266,11 +267,11 @@ class MeshBlockComponents(BlockTask):
                 pairs_out)
 
         empty_blocks = np.nonzero(max_ids == 0)[0].tolist()
-        with open(cfg["offsets_path"], "w") as f:
-            json.dump({"offsets": offsets.tolist(),
-                       "empty_blocks": empty_blocks,
-                       "n_labels": int(max_ids.sum()),
-                       "covered_faces": covered_faces}, f)
+        write_config(cfg["offsets_path"],
+                     {"offsets": offsets.tolist(),
+                      "empty_blocks": empty_blocks,
+                      "n_labels": int(max_ids.sum()),
+                      "covered_faces": covered_faces})
         log_fn(f"mesh CC: {len(block_list)} blocks over {n_dev} devices, "
                f"{int(max_ids.sum())} labels, "
                f"{len(covered_faces)} faces on device")

@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..core.blocking import Blocking
+from ..core.config import write_config
 from ..core.runtime import BlockTask
 from ..core.storage import VarlenDataset, file_reader
 from ..core.workflow import FileTarget, Task
@@ -86,9 +87,9 @@ class BlockNodeLabels(BlockTask):
         # record shard geometry once; the merge task reads it back so the two
         # tasks can never disagree on shard_size/n_labels (separately
         # configurable task configs must not shift shard boundaries)
-        with open(os.path.join(out_dir, "meta.json"), "w") as f:
-            _json.dump({"shard_size": int(self.task_config["shard_size"]),
-                        "n_labels": int(n_labels)}, f)
+        write_config(os.path.join(out_dir, "meta.json"),
+                     {"shard_size": int(self.task_config["shard_size"]),
+                      "n_labels": int(n_labels)})
         self.run_jobs(block_list, {
             "ws_path": self.ws_path, "ws_key": self.ws_key,
             "input_path": self.input_path, "input_key": self.input_key,

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..core.blocking import Blocking
+from ..core.config import write_config
 from ..core.runtime import BlockTask
 from ..core.storage import file_reader
 from ..core.workflow import FileTarget, Task
@@ -262,8 +263,7 @@ class IdFilter(BlockTask):
         filter_mask = np.isin(node_labels,
                               np.asarray(cfg["filter_labels"], "uint64"))
         filter_ids = np.flatnonzero(filter_mask)
-        with open(cfg["output_path"], "w") as f:
-            json.dump([int(i) for i in filter_ids], f)
+        write_config(cfg["output_path"], [int(i) for i in filter_ids])
         log_fn(f"filtering {len(filter_ids)} / {len(node_labels)} ids")
 
 
@@ -640,8 +640,7 @@ class ApplyThreshold(BlockTask):
         else:
             mask = feats == cfg["threshold"]
         filter_ids = np.flatnonzero(mask)
-        with open(cfg["out_path"], "w") as f:
-            json.dump([int(i) for i in filter_ids], f)
+        write_config(cfg["out_path"], [int(i) for i in filter_ids])
         log_fn(f"filtering {len(filter_ids)} / {len(feats)} ids "
                f"({mode} {cfg['threshold']})")
 

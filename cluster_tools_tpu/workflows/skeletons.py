@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..core.config import write_config
 from ..core.runtime import BlockTask
 from ..core.storage import VarlenDataset, file_reader
 from ..core.workflow import FileTarget, Task
@@ -274,8 +275,7 @@ class SkeletonEvaluation(BlockTask):
             "per_object_correctness": {str(k): v
                                        for k, v in correctness.items()},
         }
-        with open(cfg["output_path"], "w") as f:
-            json.dump(result, f)
+        write_config(cfg["output_path"], result)
         log_fn(f"skeleton eval: correctness="
                f"{result['mean_correctness']:.4f}, "
                f"{n_merges} false merges over {len(correctness)} skeletons")

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..core.blocking import Blocking, iterate_faces
+from ..core.config import write_config
 from ..core.runtime import BlockTask
 from ..core.storage import file_reader
 from ..core.workflow import Task
@@ -142,8 +143,7 @@ class BlockComponents(BlockTask):
 
         path = os.path.join(job_config["tmp_folder"],
                             f"block_components_max_ids_job_{job_id}.json")
-        with open(path, "w") as f:
-            json.dump(max_ids, f)
+        write_config(path, max_ids)
 
 
 class ResidentBlockComponents(BlockTask):
@@ -340,11 +340,10 @@ class ResidentBlockComponents(BlockTask):
             ids[bid] = mx
         offsets = np.zeros(n_blocks, dtype="uint64")
         np.cumsum(ids[:-1], out=offsets[1:])
-        with open(cfg["offsets_path"], "w") as f:
-            json.dump({"offsets": offsets.tolist(),
-                       "empty_blocks":
-                           np.nonzero(ids == 0)[0].tolist(),
-                       "n_labels": int(ids.sum())}, f)
+        write_config(cfg["offsets_path"],
+                     {"offsets": offsets.tolist(),
+                      "empty_blocks": np.nonzero(ids == 0)[0].tolist(),
+                      "n_labels": int(ids.sum())})
 
 
 class MergeOffsets(BlockTask):
@@ -381,10 +380,10 @@ class MergeOffsets(BlockTask):
         np.cumsum(max_ids[:-1], out=offsets[1:])
         empty_blocks = np.nonzero(max_ids == 0)[0].tolist()
         n_labels = int(max_ids.sum())
-        with open(cfg["offsets_path"], "w") as f:
-            json.dump({"offsets": offsets.tolist(),
-                       "empty_blocks": empty_blocks,
-                       "n_labels": n_labels}, f)
+        write_config(cfg["offsets_path"],
+                     {"offsets": offsets.tolist(),
+                      "empty_blocks": empty_blocks,
+                      "n_labels": n_labels})
         log_fn(f"n_labels: {n_labels}, empty blocks: {len(empty_blocks)}")
 
 

@@ -1,0 +1,495 @@
+"""ctt-lint: fixture corpus + repo gate + lock-order witness (ISSUE 18).
+
+Three layers:
+
+* known-BAD fixtures — one tiny file per rule, asserting the exact rule
+  id AND line number, so a pass that stops firing (or fires on the
+  wrong line) fails loudly;
+* known-GOOD corpus — the idioms each pass was explicitly tuned NOT to
+  flag (``os.path.join`` under a lock, ``jax.random`` inside jit, the
+  tmp+``os.replace`` write, dense-label int32 casts...) must produce
+  ZERO findings;
+* the repo gate — the full analyzer over the real tree must report zero
+  unsuppressed findings (this is the tier-1 lint gate), plus the
+  dynamic lock-order witness catching a seeded A->B / B->A inversion.
+"""
+
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from cluster_tools_tpu import analysis
+from cluster_tools_tpu.analysis import ALL_RULES, run_analysis, sources
+from cluster_tools_tpu.core import runtime
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _fixture(tmp_path, relname, src):
+    """Write a fixture source file; subdir components ('core/x.py')
+    trigger the directory-scoped passes just like in the real tree."""
+    path = tmp_path / relname
+    path.parent.mkdir(parents=True, exist_ok=True)
+    src = textwrap.dedent(src).lstrip("\n")
+    path.write_text(src)
+    return str(path), src
+
+
+def _line_of(src, needle, nth=1):
+    """1-based line number of the nth line containing ``needle``."""
+    hits = [i for i, ln in enumerate(src.splitlines(), start=1)
+            if needle in ln]
+    assert len(hits) >= nth, "fixture rotted: %r not found" % needle
+    return hits[nth - 1]
+
+
+def _findings(path, rule):
+    report = run_analysis(files=[path], rules=[rule])
+    return report, [(f.rule, f.line) for f in report["findings"]]
+
+
+# ---------------------------------------------------------------------------
+# known-bad fixtures: exact rule id + line number per pass
+# ---------------------------------------------------------------------------
+
+def test_trace_purity_fixture(tmp_path):
+    path, src = _fixture(tmp_path, "bad_trace.py", """
+        import time
+
+        import jax
+
+        @jax.jit
+        def step(x):
+            time.sleep(0.01)
+            print("step", x)
+            return x + 1
+    """)
+    _report, got = _findings(path, "trace-purity")
+    assert ("trace-purity", _line_of(src, "time.sleep")) in got
+    assert ("trace-purity", _line_of(src, "print(")) in got
+    assert len(got) == 2
+
+
+def test_trace_purity_transitive_closure(tmp_path):
+    """A same-module helper CALLED from a jit'd function is traced too."""
+    path, src = _fixture(tmp_path, "bad_trace_helper.py", """
+        import time
+
+        import jax
+
+        def _inner(x):
+            time.sleep(0.01)
+            return x
+
+        @jax.jit
+        def outer(x):
+            return _inner(x)
+    """)
+    _report, got = _findings(path, "trace-purity")
+    assert got == [("trace-purity", _line_of(src, "time.sleep"))]
+
+
+def test_blocking_under_lock_fixture(tmp_path):
+    path, src = _fixture(tmp_path, "core/bad_locks.py", """
+        import json
+        import threading
+
+        _lock = threading.Lock()
+
+        def save(path, obj):
+            with _lock:
+                with open(path, "w") as f:
+                    json.dump(obj, f)
+    """)
+    _report, got = _findings(path, "blocking-under-lock")
+    assert ("blocking-under-lock", _line_of(src, "open(path")) in got
+    assert ("blocking-under-lock", _line_of(src, "json.dump")) in got
+
+
+def test_blocking_under_lock_is_core_scoped(tmp_path):
+    """The same source OUTSIDE core/ is not in scope for the lock pass."""
+    path, _src = _fixture(tmp_path, "elsewhere/bad_locks.py", """
+        import json
+        import threading
+
+        _lock = threading.Lock()
+
+        def save(path, obj):
+            with _lock:
+                with open(path, "w") as f:
+                    json.dump(obj, f)
+    """)
+    _report, got = _findings(path, "blocking-under-lock")
+    assert got == []
+
+
+def test_stage_registry_fixture(tmp_path):
+    path, src = _fixture(tmp_path, "bad_stage.py", """
+        from cluster_tools_tpu.core.telemetry import stage_add
+
+        def work(n):
+            stage_add("never-registered-stage", 0.5)
+            stage_add(f"stage-{n}", 0.5)
+    """)
+    _report, got = _findings(path, "stage-registry")
+    assert ("stage-registry",
+            _line_of(src, "never-registered-stage")) in got
+    assert ("stage-registry", _line_of(src, 'f"stage-')) in got
+
+
+def test_metric_registry_fixture(tmp_path):
+    path, src = _fixture(tmp_path, "bad_metric.py", """
+        FAMILY = "ctt_bogus_family_total"
+
+        def family_for(op):
+            return f"ctt_{op}_seconds"
+    """)
+    _report, got = _findings(path, "metric-registry")
+    assert ("metric-registry",
+            _line_of(src, "ctt_bogus_family_total")) in got
+    assert ("metric-registry", _line_of(src, 'f"ctt_')) in got
+
+
+def test_dtype_f64_fixture(tmp_path):
+    path, src = _fixture(tmp_path, "ops/bad_f64.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def affinities(x):
+            y = x.astype(jnp.float64)
+            return jnp.zeros_like(y, dtype="float64")
+    """)
+    _report, got = _findings(path, "dtype-f64")
+    assert ("dtype-f64", _line_of(src, "astype")) in got
+    assert ("dtype-f64", _line_of(src, 'dtype="float64"')) in got
+
+
+def test_dtype_f64_only_in_traced_scope(tmp_path):
+    """Host-side f64 staging (NOT jit'd) is deliberately out of scope."""
+    path, _src = _fixture(tmp_path, "ops/good_f64_host.py", """
+        import numpy as np
+
+        def gaussian_kernel(sigma):
+            return np.arange(9).astype(np.float64) * sigma
+    """)
+    _report, got = _findings(path, "dtype-f64")
+    assert got == []
+
+
+def test_dtype_int32_fixture(tmp_path):
+    path, src = _fixture(tmp_path, "ops/bad_i32.py", """
+        import jax.numpy as jnp
+
+        def pack(seed_ids, labels):
+            small_seeds = seed_ids.astype(jnp.int32)
+            dense = labels.astype(jnp.int32)
+            return small_seeds, dense
+    """)
+    _report, got = _findings(path, "dtype-int32")
+    # seed receiver flagged; block-local dense labels deliberately NOT
+    assert got == [("dtype-int32", _line_of(src, "seed_ids.astype"))]
+
+
+def test_config_key_fixture(tmp_path):
+    path, src = _fixture(tmp_path, "bad_config.py", """
+        def resources(job):
+            gc = job["global_config"]
+            retries = gc.get("max_num_retires", 3)
+            shape = job["global_config"]["block_shpae"]
+            return retries, shape
+    """)
+    _report, got = _findings(path, "config-key")
+    assert ("config-key", _line_of(src, "max_num_retires")) in got
+    assert ("config-key", _line_of(src, "block_shpae")) in got
+    assert len(got) == 2
+
+
+def test_atomic_write_fixture(tmp_path):
+    path, src = _fixture(tmp_path, "bad_write.py", """
+        import json
+
+        def save(path, obj):
+            with open(path, "w") as f:
+                json.dump(obj, f)
+    """)
+    _report, got = _findings(path, "atomic-write")
+    assert got == [("atomic-write", _line_of(src, "json.dump"))]
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    path = tmp_path / "broken.py"
+    path.write_text("def oops(:\n    pass\n")
+    report = run_analysis(files=[str(path)])
+    got = [(f.rule, f.line) for f in report["findings"]]
+    assert got == [("parse-error", 1)]
+
+
+# ---------------------------------------------------------------------------
+# pragma discipline
+# ---------------------------------------------------------------------------
+
+def test_pragma_with_reason_suppresses(tmp_path):
+    path, src = _fixture(tmp_path, "suppressed.py", """
+        import json
+
+        def save(path, obj):
+            with open(path, "w") as f:
+                # ctt-lint: disable=atomic-write (test fixture: scratch file, loss is fine)
+                json.dump(obj, f)
+    """)
+    report = run_analysis(files=[path], rules=["atomic-write"])
+    assert report["findings"] == []
+    assert [(f.rule, f.line) for f in report["suppressed"]] == [
+        ("atomic-write", _line_of(src, "json.dump"))]
+    assert "scratch file" in report["suppressed"][0].reason
+
+
+def test_pragma_without_reason_does_not_suppress(tmp_path):
+    path, src = _fixture(tmp_path, "reasonless.py", """
+        import json
+
+        def save(path, obj):
+            with open(path, "w") as f:
+                json.dump(obj, f)  # ctt-lint: disable=atomic-write
+    """)
+    report = run_analysis(files=[path])
+    got = {(f.rule, f.line) for f in report["findings"]}
+    line = _line_of(src, "json.dump")
+    # the original finding survives AND the bare pragma is itself flagged
+    assert ("atomic-write", line) in got
+    assert ("pragma-reason", line) in got
+    assert report["suppressed"] == []
+
+
+# ---------------------------------------------------------------------------
+# known-good corpus: zero false positives on the tuned-out idioms
+# ---------------------------------------------------------------------------
+
+def test_known_good_corpus_zero_findings(tmp_path):
+    good_core, _ = _fixture(tmp_path, "core/good_locks.py", """
+        import os
+        import threading
+
+        _lock = threading.Lock()
+        _cond = threading.Condition(_lock)
+
+        def summarize(parts, root):
+            with _lock:
+                label = ", ".join(parts)
+                path = os.path.join(root, label)
+                _cond.wait(timeout=0.1)
+                return path
+    """)
+    good_jit, _ = _fixture(tmp_path, "ops/good_jit.py", """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def noisy(x, key):
+            n = np.prod(x.shape)
+            return x + jax.random.normal(key, x.shape) / n
+    """)
+    good_write, _ = _fixture(tmp_path, "good_write.py", """
+        import json
+        import os
+
+        def save(path, obj):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(obj, f)
+            os.replace(tmp, path)
+    """)
+    good_names, _ = _fixture(tmp_path, "good_names.py", """
+        from cluster_tools_tpu.core.telemetry import stage_add
+
+        def work():
+            stage_add("sync-execute", 0.5)
+            return "ctt_slo_burn_rate"
+    """)
+    report = run_analysis(
+        files=[good_core, good_jit, good_write, good_names])
+    assert report["findings"] == [], \
+        "\n".join(f.format() for f in report["findings"])
+    assert report["suppressed"] == []
+
+
+# ---------------------------------------------------------------------------
+# repo gate (tier-1): the real tree must be lint-clean
+# ---------------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """The whole-package analyzer run — THE lint gate.  Any unsuppressed
+    finding fails tier-1; suppressions must carry a reason (enforced by
+    the pragma-reason rule, re-asserted here on the live report)."""
+    t0 = time.monotonic()
+    report = run_analysis()
+    elapsed = time.monotonic() - t0
+    assert report["findings"] == [], \
+        "\n".join(f.format() for f in report["findings"])
+    assert all(f.reason for f in report["suppressed"])
+    assert report["files_scanned"] > 50
+    assert elapsed < 10.0, "analyzer too slow for tier-1: %.1fs" % elapsed
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad, _ = _fixture(tmp_path, "bad_write.py", """
+        import json
+
+        def save(path, obj):
+            with open(path, "w") as f:
+                json.dump(obj, f)
+    """)
+    out_json = str(tmp_path / "LINT.json")
+    assert analysis.main([bad, "--json", out_json]) == 1
+    captured = capsys.readouterr()
+    assert "atomic-write" in captured.out
+    import json as _json
+    with open(out_json) as f:
+        payload = _json.load(f)
+    assert payload["cmd"] == "lint"
+    assert payload["n_findings"] == 1
+    assert payload["counts"] == {"atomic-write": 1}
+    # the clean tree exits 0 (same check the tier-1 gate makes)
+    assert analysis.main(["--quiet"]) == 0
+
+
+def test_cli_rejects_unknown_rule():
+    with pytest.raises(SystemExit):
+        analysis.main(["--rules", "not-a-rule"])
+
+
+def test_all_rules_have_a_pass():
+    covered = {r for p in analysis.load_passes() for r in p.rules}
+    covered |= {"pragma-reason", "parse-error"}   # runner-level rules
+    assert covered == set(ALL_RULES)
+
+
+# ---------------------------------------------------------------------------
+# dynamic lock-order witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def witness():
+    runtime.lock_witness_configure(enabled=True, ring=64)
+    try:
+        yield
+    finally:
+        runtime.lock_witness_configure(enabled=False)
+
+
+def test_witness_detects_seeded_inversion(witness):
+    """A->B in one thread, B->A in another: the classic deadlock seed.
+    The witness flags it from the acquisition graph WITHOUT needing the
+    unlucky interleaving to actually wedge."""
+    lock_a = runtime.named_lock("A")
+    lock_b = runtime.named_lock("B")
+
+    def a_then_b():
+        with lock_a:
+            with lock_b:
+                pass
+
+    t = threading.Thread(target=a_then_b)
+    t.start()
+    t.join()
+
+    with lock_b:
+        with lock_a:
+            pass
+
+    report = runtime.lock_witness_report()
+    inversions = [v for v in report["violations"]
+                  if v["kind"] == "lock-order-inversion"]
+    assert inversions, report
+    v = inversions[0]
+    assert v["edge"] == ["B", "A"]
+    assert v["cycle"][0] == v["cycle"][-1] == "A"
+    assert ("A", "B") in [tuple(e) for e in report["edges"]]
+    assert set(report["locks"]) == {"A", "B"}
+
+
+def test_witness_consistent_order_is_clean(witness):
+    lock_a = runtime.named_lock("A")
+    lock_b = runtime.named_lock("B")
+    for _ in range(3):
+        with lock_a:
+            with lock_b:
+                pass
+    assert runtime.lock_witness_report()["violations"] == []
+
+
+def test_witness_blocking_under_lock(witness):
+    lock = runtime.named_lock("L")
+    with runtime.witness_blocking("free-io"):
+        pass                               # not held: no violation
+    with lock:
+        with runtime.witness_blocking("status-write"):
+            pass
+    report = runtime.lock_witness_report()
+    blocked = [v for v in report["violations"]
+               if v["kind"] == "blocking-under-lock"]
+    assert len(blocked) == 1
+    assert blocked[0]["blocking"] == "status-write"
+    assert blocked[0]["held"] == ["L"]
+
+
+def test_witness_reentrant_rlock_not_an_inversion(witness):
+    rlock = runtime.named_lock("R", rlock=True)
+    with rlock:
+        with rlock:
+            pass
+    assert runtime.lock_witness_report()["violations"] == []
+
+
+def test_witness_dump_artifact(witness, tmp_path):
+    lock = runtime.named_lock("D")
+    with lock:
+        pass
+    out = str(tmp_path / "WITNESS.json")
+    runtime.lock_witness_dump(out)
+    import json as _json
+    with open(out) as f:
+        payload = _json.load(f)
+    assert payload["enabled"] is True
+    assert payload["locks"] == ["D"]
+
+
+def test_witness_disabled_is_off_path():
+    """Disabled (the production default): named_lock returns PLAIN
+    threading primitives and witness_blocking returns one shared no-op
+    object — the hot path pays a single module-global read."""
+    runtime.lock_witness_configure(enabled=False)
+    assert not runtime.witness_enabled()
+    lock = runtime.named_lock("prod")
+    assert isinstance(lock, type(threading.Lock()))
+    cm1 = runtime.witness_blocking("a")
+    cm2 = runtime.witness_blocking("b")
+    assert cm1 is cm2                      # the shared null singleton
+    t0 = time.monotonic()
+    for _ in range(100_000):
+        with runtime.witness_blocking("hot"):
+            pass
+    assert time.monotonic() - t0 < 1.0
+    # nothing was recorded
+    report = runtime.lock_witness_report()
+    assert report["violations"] == [] and report["locks"] == []
+
+
+def test_witness_condition_compat(witness):
+    """threading.Condition must accept a witnessed lock (server._work
+    wraps server._lock) — acquire/release/context protocol."""
+    lock = runtime.named_lock("cond-lock")
+    cond = threading.Condition(lock)
+    with cond:
+        cond.wait(timeout=0.01)
+        cond.notify_all()
+    report = runtime.lock_witness_report()
+    assert "cond-lock" in report["locks"]
+    assert report["violations"] == []

@@ -12,13 +12,13 @@ Two guards against artifact drift, both cheap enough for tier-1:
   is caught at test time instead of at the next trace-diff run.
 """
 
-import glob
 import json
 import os
 
 import pytest
 
 import bench
+from cluster_tools_tpu.analysis import sources
 from cluster_tools_tpu.core import telemetry
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -92,7 +92,9 @@ _BENCH_IDENTITY_KEYS = ("metric", "config", "cmd")
 
 
 def _committed(pattern):
-    return sorted(glob.glob(os.path.join(REPO, pattern)))
+    # delegates to the shared analysis.sources walker (ISSUE 18 satellite
+    # 6) so "what counts as a committed artifact" has one definition
+    return sources.committed_artifacts(pattern)
 
 
 def test_committed_artifacts_exist():

@@ -375,37 +375,23 @@ def test_metrics_path_writes_prometheus_snapshot(tmp_path):
 # vanish silently into stage_counts)
 # ---------------------------------------------------------------------------
 
-_STAGE_CALL = re.compile(
-    r"\b(?:timed_)?stage(?:_add|_bytes)?\(\s*\n?\s*\"([A-Za-z0-9_.:-]+)\"")
-
-
 def test_stage_literals_are_registered():
-    """Grep the whole package for stage("...")/stage_add("...")/
-    stage_bytes("...") literals: every name must be in
-    telemetry.STAGE_REGISTRY — an unregistered (typo'd) stage fails
-    tier-1 instead of silently opening a new stage_counts bucket."""
-    pkg = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "cluster_tools_tpu")
-    found = {}
-    for root, _dirs, files in os.walk(pkg):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            with open(path) as f:
-                src = f.read()
-            for m in _STAGE_CALL.finditer(src):
-                found.setdefault(m.group(1), []).append(
-                    os.path.relpath(path, pkg))
-    assert found, "lint found no stage literals — regex rotted?"
-    unregistered = {n: files for n, files in found.items()
-                    if not telemetry.is_registered(n)}
-    assert not unregistered, (
-        f"unregistered stage names {unregistered} — add them to "
-        "telemetry.STAGE_REGISTRY (or fix the typo)")
+    """Thin shim (ISSUE 18): the PR-15 grep lint now lives in the
+    unified ctt-lint runner as a real AST pass (analysis.registry),
+    which additionally catches f-string/concatenated stage names the
+    grep structurally could not.  Same test id, same guarantee."""
+    from cluster_tools_tpu import analysis
+    from cluster_tools_tpu.analysis import registry as areg
+
+    report = analysis.run_analysis(passes=[areg.STAGE_PASS])
+    bad = [f.format() for f in report["findings"]
+           if f.rule == "stage-registry"]
+    assert not bad, "\n".join(bad)
     # the canonical buckets the bench/docs rely on must actually be used
+    src = "\n".join(open(p).read()
+                    for p in analysis.sources.source_files())
     for name in ("sync-execute", "sync-compile", "store-write"):
-        assert name in found
+        assert f'"{name}"' in src
 
 
 def test_register_stage_extension():
@@ -572,37 +558,25 @@ def test_lint_enforces_histogram_invariants():
 # to Prometheus family names)
 # ---------------------------------------------------------------------------
 
-_METRIC_LITERAL = re.compile(r'"(ctt_[a-zA-Z0-9_]+)"')
-
-
 def test_metric_literals_are_registered():
-    """Every `ctt_*` family-name literal in the package (and bench.py)
-    must be in telemetry.METRIC_REGISTRY — a typo'd metric name fails
-    tier-1 instead of silently forking a new time series."""
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = [os.path.join(here, "bench.py")]
-    pkg = os.path.join(here, "cluster_tools_tpu")
-    for root, _dirs, files in os.walk(pkg):
-        paths += [os.path.join(root, fn) for fn in files
-                  if fn.endswith(".py")]
-    found = {}
-    for path in paths:
-        with open(path) as f:
-            src = f.read()
-        for m in _METRIC_LITERAL.finditer(src):
-            found.setdefault(m.group(1), []).append(
-                os.path.relpath(path, here))
-    assert found, "metric lint found no ctt_ literals — regex rotted?"
-    unregistered = {n: fs for n, fs in found.items()
-                    if not telemetry.is_registered_metric(n)}
-    assert not unregistered, (
-        f"unregistered metric names {unregistered} — add them to "
-        "telemetry.METRIC_REGISTRY (or fix the typo)")
-    # the serve-path families this PR adds must actually be emitted
+    """Thin shim (ISSUE 18): the PR-16 metric-name grep lint now lives
+    in the unified ctt-lint runner as a real AST pass
+    (analysis.registry), which additionally flags dynamic ``ctt_*``
+    family names.  Same test id, same guarantee."""
+    from cluster_tools_tpu import analysis
+    from cluster_tools_tpu.analysis import registry as areg
+
+    report = analysis.run_analysis(passes=[areg.METRIC_PASS])
+    bad = [f.format() for f in report["findings"]
+           if f.rule == "metric-registry"]
+    assert not bad, "\n".join(bad)
+    # the serve-path families PR 16 added must actually be emitted
+    src = "\n".join(open(p).read()
+                    for p in analysis.sources.source_files())
     for name in ("ctt_server_request_latency_seconds",
                  "ctt_slo_burn_rate",
                  "ctt_telemetry_dropped_spans_total"):
-        assert name in found
+        assert f'"{name}"' in src
 
 
 def test_dropped_span_counter_exported(fake_clock, tmp_path):
