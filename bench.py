@@ -1187,6 +1187,256 @@ def main_serve():
 
 
 # ---------------------------------------------------------------------------
+# `edits` config: interactive proofreading round-trip (ISSUE 19).
+# One small watershed->multicut instance is solved through the real
+# workflow chain, then a stream of merge/split edits runs through the
+# resident server's edit lane WHILE a bulk tenant floods ROI requests.
+# Gates asserted before the artifact is written: median edit round-trip
+# < 0.5x a from-scratch re-solve of the same geometry; edits not starved
+# (median edit queue-wait <= median bulk queue-wait); incremental and
+# from-scratch re-solve of the edited problem produce identical
+# assignments.  Same honesty caveat as BENCH_warm: 1-core emulated mesh,
+# so absolute times are host-bound — the RATIOS are the signal.
+# ---------------------------------------------------------------------------
+
+EDITS_SEED = 19
+EDITS_N_MERGE = 6
+EDITS_N_SPLIT = 6
+
+
+def _edits_instance(base, shape):
+    """Solve one watershed->multicut instance (threads target: the edits
+    path is host-side) and return its paths."""
+    import cluster_tools_tpu as ctt
+    from cluster_tools_tpu.core.config import ConfigDir
+    from cluster_tools_tpu.core.storage import file_reader
+    from cluster_tools_tpu.workflows.segmentation import (
+        MulticutSegmentationWorkflow)
+    from cluster_tools_tpu.workflows.watershed import WatershedWorkflow
+
+    config_dir = os.path.join(base, "configs")
+    cfg = ConfigDir(config_dir)
+    cfg.write_global_config({"block_shape": [10, 10, 10],
+                             "max_num_retries": 0})
+    cfg.write_task_config("watershed", {"threshold": 0.4,
+                                        "size_filter": 8, "impl": "host"})
+    _, bnd = synthetic_instance(shape, n_cells=max(
+        int(np.prod(shape) / 6000), 6), seed=EDITS_SEED)
+    path = os.path.join(base, "data.n5")
+    with file_reader(path) as f:
+        f.require_dataset("bmap", shape=shape, chunks=(10, 10, 10),
+                          dtype="float32")[:] = bnd
+    tmp_folder = os.path.join(base, "tmp")
+    ws = WatershedWorkflow(
+        input_path=path, input_key="bmap", output_path=path,
+        output_key="ws", tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads")
+    mc = MulticutSegmentationWorkflow(
+        input_path=path, input_key="bmap", ws_path=path, ws_key="ws",
+        problem_path=os.path.join(base, "problem.n5"), output_path=path,
+        output_key="seg", tmp_folder=tmp_folder, config_dir=config_dir,
+        max_jobs=2, target="threads", n_scales=1, dependency=ws)
+    assert ctt.build([mc]), "instance build failed"
+    return {"data": path, "problem": os.path.join(base, "problem.n5"),
+            "assignments": os.path.join(tmp_folder,
+                                        "multicut_assignments.npy")}
+
+
+def _edit_pairs(session, table, n_pairs, same_segment):
+    """Disjoint adjacent fragment pairs sharing >= 1 subproblem block,
+    currently in the same (split candidates) / different (merge
+    candidates) segment — deterministic scan over the s0 edge list."""
+    used, out = set(), []
+    for u, v in session.base_uv:
+        ou, ov = int(session.s0_nodes[u]), int(session.s0_nodes[v])
+        if ou == 0 or ov == 0 or ou in used or ov in used:
+            continue
+        if bool(table[ou] == table[ov]) != same_segment:
+            continue
+        if not session.affected_blocks([ou, ov]):
+            continue
+        out.append((ou, ov))
+        used.update((ou, ov))
+        if len(out) == n_pairs:
+            break
+    return out
+
+
+def main_edits():
+    import threading
+
+    from cluster_tools_tpu.core import telemetry
+    from cluster_tools_tpu.core.server import ResidentSegmentationServer
+    from cluster_tools_tpu.edits import (EditLog, EditPipeline, EditSession,
+                                         stable_relabel)
+
+    smoke = "--smoke" in sys.argv[1:]
+    argv = sys.argv[1:]
+    out_path = argv[argv.index("--out") + 1] if "--out" in argv else None
+    base = "/tmp/ctt_bench_edits"
+    shutil.rmtree(base, ignore_errors=True)
+    os.makedirs(base)
+    shape = (24, 24, 24) if smoke else (40, 40, 40)
+    paths = _edits_instance(base, shape)
+
+    # baseline: from-scratch re-solve of the SAME geometry (every
+    # subproblem cold + reduce + global), median of 3
+    t_full = []
+    for _ in range(3):
+        s = EditSession(paths["problem"])
+        t0 = time.perf_counter()
+        s.solve(incremental=False)
+        t_full.append(time.perf_counter() - t0)
+    full_solve_s = float(np.median(t_full))
+
+    probe = EditSession(paths["problem"])
+    table0 = np.load(paths["assignments"])
+    merges = _edit_pairs(probe, table0, EDITS_N_MERGE, same_segment=False)
+    splits = _edit_pairs(probe, table0, EDITS_N_SPLIT, same_segment=True)
+    edit_stream = [("merge", p) for p in merges] + \
+        [("split", p) for p in splits]
+    assert len(edit_stream) >= 5, "instance too merged to mine edit pairs"
+
+    # the bulk tenant: a synthetic ROI pipeline (prepare + 4 blocks x
+    # ~2 ms + tail) flooding the server at about its service rate, so
+    # the queue sits near saturation while the edits arrive
+    class _BulkStub:
+        n_blocks = 4
+
+        def prepare(self, volume):
+            time.sleep(0.002)
+            return {}
+
+        def run_block(self, ctx, bid):
+            time.sleep(0.002)
+            return bid
+
+        def finalize(self, ctx, block_results):
+            time.sleep(0.001)
+            return {"n_segments": 1}
+
+    log = EditLog(os.path.join(base, "edits.jsonl"))
+    session = EditSession(paths["problem"],
+                          flight_dir=os.path.join(base, "flight"))
+    pipe = EditPipeline(session, log, paths["assignments"],
+                        ws_path=paths["data"], ws_key="ws",
+                        output_path=paths["data"], output_key="seg")
+    srv = ResidentSegmentationServer(os.path.join(base, "srv"),
+                                     _BulkStub(), metrics_path="",
+                                     lane_pipelines={"edit": pipe})
+    srv.start()
+    stop = threading.Event()
+
+    def bulk_client():
+        i = 0
+        while not stop.is_set():
+            try:
+                srv.submit("bulk-tenant", f"ROI{i}")
+            except RuntimeError:        # shutdown raced the last submit
+                return
+            i += 1
+            time.sleep(0.004)
+
+    flood = threading.Thread(target=bulk_client, daemon=True)
+    flood.start()
+    time.sleep(0.1)                     # let the bulk backlog form
+    edit_rows = []
+    for op, (a, b) in edit_stream:
+        h = srv.submit("proofreader", {"op": op, "fragments": [a, b]},
+                       lane="edit")
+        res = h.result(300)
+        edit_rows.append({
+            "op": op, "fragments": [a, b], "edit_id": res["edit_id"],
+            "round_trip_s": res["round_trip_s"],
+            "affected_blocks": len(res["affected_blocks"]),
+            "touched_blocks": len(res["touched_blocks"]),
+            "changed_fragments": res["changed_fragments"]})
+    stop.set()
+    _, wait_hist, _ = srv.latency_histograms()
+    bulk_served = srv.stats()["tenants_served"].get("bulk-tenant", 0)
+    srv.shutdown(drain=False)
+    flood.join(timeout=5)
+
+    # identity gate: replaying the log from scratch (every cache
+    # ignored) reproduces the served assignment table exactly
+    final_table = np.load(paths["assignments"])
+    scratch = EditSession(paths["problem"])
+    scratch.replay(EditLog(log.path))
+    labels_scr = scratch.solve(incremental=False)
+    identity = bool(np.array_equal(
+        stable_relabel(final_table, scratch.s0_nodes.astype("int64"),
+                       labels_scr), final_table))
+
+    rts = sorted(r["round_trip_s"] for r in edit_rows)
+    median_rt = float(np.median(rts))
+    ratio = median_rt / full_solve_s
+    edit_p50 = wait_hist["edit"].quantile(0.5) if "edit" in wait_hist \
+        else None
+    bulk_p50 = wait_hist["bulk"].quantile(0.5) if "bulk" in wait_hist \
+        else None
+    not_starved = (edit_p50 is not None and bulk_p50 is not None
+                   and edit_p50 <= bulk_p50)
+    gates = {"ratio_lt_0_5": ratio < 0.5, "edit_not_starved": not_starved,
+             "identity": identity}
+    if not smoke:
+        assert all(gates.values()), gates
+
+    out = {
+        "metric": "edit_roundtrip",
+        "mode": "smoke" if smoke else "full",
+        "seed": EDITS_SEED,
+        "note": ("interactive proofreading round-trip on the resident "
+                 "server's edit lane (submit -> resolve -> warm "
+                 "incremental solve -> LUT patch -> touched-block "
+                 "rewrite) while a bulk tenant floods ROI requests at "
+                 "about the service rate.  full_solve_s is a from-"
+                 "scratch re-solve of the SAME geometry (every "
+                 "subproblem cold + reduce + global).  1-core emulated-"
+                 "mesh caveat as in BENCH_warm: absolute times are "
+                 "host-bound; the round-trip/full-solve ratio and the "
+                 "per-lane queue-wait split are the signal"),
+        "geometry": {
+            "shape": list(shape), "block_shape": session.block_shape,
+            "n_blocks": session.blocking.n_blocks,
+            "n_fragments": int(len(session.s0_nodes)),
+            "n_edges": int(len(session.base_uv))},
+        "full_solve_s": full_solve_s,
+        "full_solve_samples_s": t_full,
+        "edits": edit_rows,
+        "median_edit_round_trip_s": median_rt,
+        "p90_edit_round_trip_s": float(rts[int(0.9 * (len(rts) - 1))]),
+        "round_trip_over_full_solve": ratio,
+        "counters": dict(session.counters),
+        "queue_wait": {
+            "edit_p50_s": edit_p50, "bulk_p50_s": bulk_p50,
+            "edit": {str(k): v for k, v
+                     in wait_hist["edit"].cumulative().items()}
+            if "edit" in wait_hist else None,
+            "bulk": {str(k): v for k, v
+                     in wait_hist["bulk"].cumulative().items()}
+            if "bulk" in wait_hist else None},
+        "bulk_requests_served": int(bulk_served),
+        "identity_incremental_equals_scratch": identity,
+        "gates": gates,
+    }
+    out["memory"] = telemetry.memory_rollup()
+    out["peak_rss_gb"] = round(telemetry.host_peak_rss_gb(), 2)
+    if out_path is None and not smoke:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out_path = os.path.join(here, "BENCH_edits.json")
+    if out_path:
+        write_config(out_path, out)
+    print(json.dumps({
+        "metric": out["metric"], "mode": out["mode"],
+        "median_edit_round_trip_s": round(median_rt, 4),
+        "full_solve_s": round(full_solve_s, 4),
+        "ratio": round(ratio, 4),
+        "edit_p50_wait_s": edit_p50, "bulk_p50_wait_s": bulk_p50,
+        "gates": gates,
+        "detail": (os.path.basename(out_path) if out_path else None)}))
+
+
+# ---------------------------------------------------------------------------
 # `trace-diff` config: the regression gate (ISSUE 16 tentpole 3).
 # Compares two committed trace artifacts' rollups per stage and exits
 # nonzero when a device-path quantity regresses past threshold — the
@@ -1262,5 +1512,7 @@ if __name__ == "__main__":
         main_trace()
     elif os.environ.get("BENCH_SERVE") or "serve" in sys.argv[1:]:
         main_serve()
+    elif os.environ.get("BENCH_EDITS") or "edits" in sys.argv[1:]:
+        main_edits()
     else:
         main()

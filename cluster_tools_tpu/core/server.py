@@ -89,10 +89,15 @@ class RequestHandle:
 
 class _Request:
     def __init__(self, req_id: str, tenant: str, volume, params: Dict,
-                 n_blocks: int, status_path: str, lane: str = "bulk"):
+                 n_blocks: int, status_path: str, lane: str = "bulk",
+                 pipeline=None):
         self.req_id = req_id
         self.tenant = tenant
         self.lane = lane
+        # lane-routed pipeline (None -> the server default); stored per
+        # request so an edit-lane request keeps its pipeline even if the
+        # server's routing table changes mid-flight
+        self.pipeline = pipeline
         self.volume = volume
         self.params = dict(params)
         self.status_path = status_path
@@ -385,9 +390,15 @@ class ResidentSegmentationServer:
                  slo=None,
                  admission_hook=None,
                  latency_buckets=telemetry.DEFAULT_LATENCY_BUCKETS,
-                 occupancy_samples: int = 4096):
+                 occupancy_samples: int = 4096,
+                 lane_pipelines: Optional[Dict[str, Any]] = None):
         self.workdir = workdir
         self.pipeline = pipeline
+        # per-lane pipeline routing: lane name -> pipeline (unlisted lanes
+        # use the default).  The edits/ subsystem mounts its EditPipeline
+        # on the "edit" lane this way — same scheduler, same telemetry,
+        # different request semantics (ISSUE 19)
+        self.lane_pipelines: Dict[str, Any] = dict(lane_pipelines or {})
         self.name = name
         # request-lifecycle clock: injectable so the load harness's
         # deterministic virtual-time mode can drive generator, server
@@ -518,12 +529,13 @@ class ResidentSegmentationServer:
                 f"request from {tenant} (lane={lane}) rejected by "
                 "admission hook")
         req_id = f"{tenant}_{next(self._seq)}"
-        n_blocks = (self.pipeline.request_n_blocks(volume)
-                    if hasattr(self.pipeline, "request_n_blocks")
-                    else self.pipeline.n_blocks)
+        pipeline = self.lane_pipelines.get(lane, self.pipeline)
+        n_blocks = (pipeline.request_n_blocks(volume)
+                    if hasattr(pipeline, "request_n_blocks")
+                    else pipeline.n_blocks)
         req = _Request(
             req_id, tenant, volume, params,
-            n_blocks=n_blocks, lane=lane,
+            n_blocks=n_blocks, lane=lane, pipeline=pipeline,
             status_path=os.path.join(self.workdir,
                                      f"request_{req_id}.status"))
         req.submitted_at = (self._clock() if arrival_t is None
@@ -672,6 +684,11 @@ class ResidentSegmentationServer:
                 "Request latency per tenant", ten))
         if self.slo is not None:
             families += self.slo.metrics_families(rep)
+        # lane-routed pipelines contribute their own families (the edit
+        # lane's ctt_edit_* counters/histograms land in the same scrape)
+        for lp in self.lane_pipelines.values():
+            if hasattr(lp, "metrics_families"):
+                families += lp.metrics_families()
         families += runtime.metrics_families()
         families += telemetry.metrics_families()
         # witness marker: the Prometheus rewrite must never run under
@@ -681,19 +698,26 @@ class ResidentSegmentationServer:
 
     # -- scheduler -----------------------------------------------------
     def _pick(self) -> Optional[_Request]:
-        """Fair pick: next tenant in round-robin order with pending work;
-        within the tenant, the OLDEST request (FIFO).  Called under the
-        lock."""
+        """Lane-aware fair pick: ``edit``-lane requests are claimed before
+        ``bulk`` within the round-robin tenant scan (interactive
+        proofreading must not wait behind streamed ROI jobs — ROADMAP
+        item 3c, minimal version); within a priority class, next tenant
+        in round-robin order, and within the tenant the OLDEST request
+        (FIFO — only each queue's head is considered, so a tenant's edit
+        never overtakes its own earlier bulk work).  With no edit
+        requests queued this degenerates to the original fair
+        round-robin.  Called under the lock."""
         tenants = list(self._queues.keys())
         if not tenants:
             return None
         n = len(tenants)
-        for i in range(n):
-            tenant = tenants[(self._rr_next + i) % n]
-            q = self._queues[tenant]
-            if q:
-                self._rr_next = (self._rr_next + i + 1) % n
-                return q[0]
+        for edit_only in (True, False):
+            for i in range(n):
+                tenant = tenants[(self._rr_next + i) % n]
+                q = self._queues[tenant]
+                if q and (q[0].lane == "edit" or not edit_only):
+                    self._rr_next = (self._rr_next + i + 1) % n
+                    return q[0]
         return None
 
     def _retire(self, req: _Request) -> None:
@@ -773,10 +797,11 @@ class ResidentSegmentationServer:
         st0 = runtime.stages_snapshot()
         cn0 = runtime.counts_snapshot()
         ex0 = runtime.exec_cache_snapshot()
+        pipeline = req.pipeline if req.pipeline is not None else self.pipeline
         try:
             if req.started_at is None:
                 req.started_at = self._clock()
-                req.ctx = self.pipeline.prepare(req.volume)
+                req.ctx = pipeline.prepare(req.volume)
                 telemetry.record("queue-wait", req.submitted_at,
                                  req.started_at, cat="queue-wait",
                                  tenant=req.tenant, request=req.req_id,
@@ -786,12 +811,12 @@ class ResidentSegmentationServer:
                                 tenant=req.tenant,
                                 request=req.req_id) as sp:
                 req.block_results.append(
-                    self.pipeline.run_block(req.ctx, bid))
+                    pipeline.run_block(req.ctx, bid))
                 telemetry.annotate_memory(sp)
             req.next_block += 1
             if req.next_block >= req.n_blocks:
-                req.result = self.pipeline.finalize(req.ctx,
-                                                    req.block_results)
+                req.result = pipeline.finalize(req.ctx,
+                                               req.block_results)
                 self._finish(req, "done")
         except Exception as e:          # noqa: BLE001 — isolate tenants
             req.error = f"{type(e).__name__}: {e}"

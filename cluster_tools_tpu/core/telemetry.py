@@ -87,6 +87,8 @@ STAGE_REGISTRY = {
     "fetch-dense", "fetch-rle",
     # store IO
     "store-read", "store-write",
+    # interactive proofreading lanes (edits/ subsystem)
+    "edit:resolve", "edit:solve", "edit:patch", "edit:write",
 }
 
 
@@ -127,6 +129,10 @@ METRIC_REGISTRY = {
     "ctt_telemetry_flight_records_total",
     # live-buffer ledger gauges (core/runtime.py metrics_families)
     "ctt_ledger_bytes", "ctt_ledger_entries",
+    # interactive proofreading (edits/service.py metrics_families)
+    "ctt_edit_applied_total", "ctt_edit_subproblems_total",
+    "ctt_edit_warm_reused_total", "ctt_edit_fallback_total",
+    "ctt_edit_blocks_rewritten_total", "ctt_edit_round_trip_seconds",
 }
 
 

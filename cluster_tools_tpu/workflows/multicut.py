@@ -20,6 +20,7 @@ Problem-container layout (mirrors the reference's problem_path, SURVEY §5.4):
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Any, Dict, List, Optional
 
@@ -67,6 +68,38 @@ def _problem_geometry(problem_path: str, fallback_bs):
 def _sub_result_path(problem_path: str, scale: int, block_id: int) -> str:
     return os.path.join(problem_path, f"s{scale}", "sub_results",
                         f"block_{block_id}.npz")
+
+
+def subproblem_signature(nodes_dense: np.ndarray, inner_uv: np.ndarray,
+                         inner_costs: np.ndarray) -> str:
+    """Content signature of one subproblem: the block's dense node set plus
+    its inner edge list and costs — exactly the inputs ``_solve_block``
+    consumes, so equal signatures imply equal cut-edge output (the solvers
+    are deterministic).  Keyed with the block id through the sub_result
+    filename, this is what the edits/ incremental solver validates its
+    warm-start cache against before reusing a persisted solution."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(
+        np.asarray(nodes_dense, dtype="int64")).tobytes())
+    h.update(np.ascontiguousarray(
+        np.asarray(inner_uv, dtype="int64")).tobytes())
+    h.update(np.ascontiguousarray(
+        np.asarray(inner_costs, dtype="float64")).tobytes())
+    return h.hexdigest()[:16]
+
+
+def load_sub_result(problem_path: str, scale: int, block_id: int):
+    """(cut_edge_ids, signature-or-None) for one persisted subproblem
+    solution; None if the sub_result does not exist.  Pre-signature
+    sub_results (older containers) load with signature None, which the
+    incremental solver treats as a cache miss."""
+    path = _sub_result_path(problem_path, scale, block_id)
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as d:
+        cut_ids = d["cut_edge_ids"]
+        sig = str(d["signature"]) if "signature" in d.files else None
+    return cut_ids.astype("int64"), sig
 
 
 def compose_to_s0(problem_path: str, scale: int,
@@ -180,9 +213,16 @@ class SolveSubproblems(BlockTask):
                 cut_inner = cls._solve_block(cfg, ctx, nodes_dense, inner,
                                              uv_dense, costs)
                 cut_ids = np.concatenate([cut_inner, outer])
+            # persist the solution keyed by (block id, content signature):
+            # the filename carries the block id, the signature stamps the
+            # subproblem inputs so the edits/ incremental solver can
+            # validate a warm-start against the live graph (ISSUE 19)
+            sig = subproblem_signature(nodes_dense, uv_dense[inner],
+                                       costs[inner])
             path = _sub_result_path(problem_path, scale, block_id)
             tmp = path + ".tmp.npz"
-            np.savez(tmp, cut_edge_ids=cut_ids.astype("int64"))
+            np.savez(tmp, cut_edge_ids=cut_ids.astype("int64"),
+                     signature=np.asarray(sig))
             os.replace(tmp, path)
             log_fn(f"processed block {block_id}")
 

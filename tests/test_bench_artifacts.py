@@ -117,6 +117,38 @@ def test_bench_artifact_schema(path):
                                       "peak_device_gb"}, path
 
 
+def test_bench_edits_artifact_schema():
+    """BENCH_edits.json (ISSUE 19): the committed proofreading artifact
+    carries the acceptance-criteria evidence — round-trip vs full-solve
+    ratio under 0.5, per-lane queue-wait histograms showing edits not
+    starved, and the incremental == from-scratch identity gate."""
+    paths = _committed("BENCH_edits.json")
+    assert paths, "BENCH_edits.json not committed"
+    with open(paths[0]) as f:
+        doc = json.load(f)
+    assert doc["metric"] == "edit_roundtrip"
+    assert doc["full_solve_s"] > 0
+    assert 0 < doc["median_edit_round_trip_s"] <= \
+        doc["p90_edit_round_trip_s"]
+    assert doc["round_trip_over_full_solve"] < 0.5
+    assert doc["identity_incremental_equals_scratch"] is True
+    assert doc["gates"] == {"ratio_lt_0_5": True,
+                            "edit_not_starved": True, "identity": True}
+    assert len(doc["edits"]) >= 5
+    for e in doc["edits"]:
+        assert e["op"] in ("merge", "split")
+        assert e["round_trip_s"] > 0 and e["affected_blocks"] >= 1
+    qw = doc["queue_wait"]
+    assert qw["edit_p50_s"] <= qw["bulk_p50_s"]
+    for lane in ("edit", "bulk"):
+        hist = qw[lane]
+        assert hist["+Inf"] == max(hist.values())    # cumulative buckets
+    c = doc["counters"]
+    assert c["applied"] == len(doc["edits"])
+    assert c["warm_reused"] > 0 and c["fallback"] == 0
+    assert doc["bulk_requests_served"] > 0
+
+
 @pytest.mark.parametrize("path",
                          [p for p in _committed("TRACE_*.json")
                           if not p.endswith("_trace.json")],

@@ -453,6 +453,82 @@ def test_metrics_prom_passes_lint_with_histograms_and_slo(tmp_path):
     assert 'le="+Inf"' in text
 
 
+def test_edit_lane_claimed_before_bulk(tmp_path):
+    """ISSUE 19 satellite: edit-lane requests are CLAIMED before bulk
+    within the round-robin tenant scan — an interactive edit never waits
+    behind another tenant's bulk backlog."""
+    pipe = StubPipeline(n_blocks=2)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe, metrics_path="")
+    srv.submit("alice", "BULK1")
+    srv.submit("alice", "BULK2")
+    srv.submit("bob", "EDIT", lane="edit")
+    while srv.step_once():
+        pass
+    # the edit runs to completion first even though it was submitted last
+    assert pipe.order == [("EDIT", 0), ("EDIT", 1),
+                          ("BULK1", 0), ("BULK1", 1),
+                          ("BULK2", 0), ("BULK2", 1)]
+
+
+def test_edit_lane_preserves_fifo_within_tenant(tmp_path):
+    """Lane priority only reorders ACROSS tenants' queue heads: a tenant's
+    own edit still waits behind its earlier bulk request (FIFO within
+    tenant is load-bearing for result consistency), then pre-empts other
+    tenants' remaining bulk work."""
+    pipe = StubPipeline(n_blocks=2)
+    srv = ResidentSegmentationServer(str(tmp_path), pipe, metrics_path="")
+    srv.submit("alice", "BULK")
+    srv.submit("alice", "EDIT", lane="edit")
+    srv.submit("bob", "B1")
+    while srv.step_once():
+        pass
+    a_events = [tag for tag, _ in pipe.order if tag in ("BULK", "EDIT")]
+    assert a_events == ["BULK", "BULK", "EDIT", "EDIT"]
+    # once alice's edit reached the queue head it jumped ahead of bob
+    assert pipe.order.index(("EDIT", 1)) < pipe.order.index(("B1", 1))
+
+
+def test_lane_pipelines_route_requests(tmp_path):
+    """lane_pipelines routes each request to its lane's pipeline
+    (captured at submit time); the default pipeline keeps serving
+    unrouted lanes, and block counts come from the routed pipeline."""
+    bulk = StubPipeline(n_blocks=2)
+    edit = StubPipeline(n_blocks=1)
+    srv = ResidentSegmentationServer(str(tmp_path), bulk, metrics_path="",
+                                     lane_pipelines={"edit": edit})
+    hb = srv.submit("alice", "B")
+    he = srv.submit("bob", "E", lane="edit")
+    while srv.step_once():
+        pass
+    assert bulk.order == [("B", 0), ("B", 1)]
+    assert edit.order == [("E", 0)]
+    assert he.result(0)["n_segments"] == 1
+    assert hb.result(0)["n_segments"] == 1
+    with open(he.status_path) as f:
+        assert json.load(f)["n_blocks"] == 1
+
+
+def test_lane_pipeline_metrics_merged_into_snapshot(tmp_path):
+    """A routed pipeline exposing metrics_families contributes its
+    families to the server's metrics.prom snapshot."""
+    from cluster_tools_tpu.core import telemetry
+
+    class MeteredStub(StubPipeline):
+        def metrics_families(self):
+            return [(telemetry.register_metric("ctt_edit_applied_total"),
+                     "counter", "edits applied", [(None, len(self.order))])]
+
+    edit = MeteredStub(n_blocks=1)
+    srv = ResidentSegmentationServer(str(tmp_path), StubPipeline(),
+                                     lane_pipelines={"edit": edit})
+    srv.submit("alice", "E", lane="edit")
+    srv.start()
+    srv.shutdown(drain=True)
+    text = open(srv.metrics_path).read()
+    assert telemetry.lint_prometheus(text) == []
+    assert "ctt_edit_applied_total 1" in text
+
+
 def test_step_once_requires_stopped_worker(tmp_path):
     pipe = StubPipeline(n_blocks=1)
     srv = ResidentSegmentationServer(str(tmp_path), pipe)
